@@ -1,0 +1,23 @@
+"""cxxnet_tpu: a TPU-native deep-learning training framework with the
+capabilities of cxxnet (config-driven CNN training, data-parallel from one
+chip to a pod), re-designed for JAX/XLA rather than ported from C++/CUDA.
+
+See SURVEY.md at the repo root for the full structural map of the reference
+and how each subsystem corresponds.
+"""
+
+from .config import parse_config_file, parse_config_string, parse_cli_overrides
+from .graph import build_graph, NetGraph
+from .model import Network
+from .trainer import Trainer
+from .optim import create_optimizer
+from .metrics import MetricSet
+from .parallel import make_mesh_context, MeshContext
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "parse_config_file", "parse_config_string", "parse_cli_overrides",
+    "build_graph", "NetGraph", "Network", "Trainer", "create_optimizer",
+    "MetricSet", "make_mesh_context", "MeshContext",
+]

@@ -1,0 +1,129 @@
+"""Config-file parser for the cxxnet key=value dialect.
+
+Implements the same tokenizing grammar as the reference parser
+(/root/reference/src/utils/config.h:20-192): whitespace-separated tokens,
+``=`` as its own token, ``#`` line comments, ``"..."`` single-line quoted
+strings with backslash escapes, and ``'...'`` multi-line quoted strings.
+Order of key=value pairs is preserved because the net-config grammar is
+order-sensitive (params attach to the preceding ``layer[...]`` line, iterator
+sections run ``data = train`` .. ``iter = end``).
+
+Unlike the reference (which silently stops parsing on a malformed token
+stream), malformed input raises :class:`ConfigError`.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Tuple
+
+ConfigPairs = List[Tuple[str, str]]
+
+
+class ConfigError(ValueError):
+    """Raised on malformed config input."""
+
+
+class _Tokenizer:
+    def __init__(self, text: str):
+        self._text = text
+        self._pos = 0
+        self._line = 1
+
+    def _getc(self) -> str:
+        if self._pos >= len(self._text):
+            return ""
+        ch = self._text[self._pos]
+        self._pos += 1
+        if ch == "\n":
+            self._line += 1
+        return ch
+
+    def tokens(self) -> Iterator[str]:
+        """Yield raw tokens; ``=`` is always its own token."""
+        ch = self._getc()
+        tok: List[str] = []
+        while ch:
+            if ch == "#":
+                while ch and ch not in "\r\n":
+                    ch = self._getc()
+                continue
+            if ch in ('"', "'"):
+                if tok:
+                    raise ConfigError(
+                        f"line {self._line}: token followed directly by string")
+                quote = ch
+                buf: List[str] = []
+                ch = self._getc()
+                while True:
+                    if not ch:
+                        raise ConfigError(f"line {self._line}: unterminated string")
+                    if ch == "\\":
+                        buf.append(self._getc())
+                    elif ch == quote:
+                        break
+                    elif ch in "\r\n" and quote == '"':
+                        raise ConfigError(f"line {self._line}: unterminated string")
+                    else:
+                        buf.append(ch)
+                    ch = self._getc()
+                yield "".join(buf)
+                ch = self._getc()
+                continue
+            if ch == "=":
+                if tok:
+                    yield "".join(tok)
+                    tok = []
+                yield "="
+                ch = self._getc()
+                continue
+            if ch in " \t\r\n":
+                if tok:
+                    yield "".join(tok)
+                    tok = []
+                ch = self._getc()
+                continue
+            tok.append(ch)
+            ch = self._getc()
+        if tok:
+            yield "".join(tok)
+
+
+def parse_config_string(text: str) -> ConfigPairs:
+    """Parse config text into an ordered list of (name, value) pairs."""
+    out: ConfigPairs = []
+    toks = list(_Tokenizer(text).tokens())
+    i = 0
+    while i < len(toks):
+        name = toks[i]
+        if name == "=":
+            raise ConfigError("expected parameter name, got '='")
+        if i + 1 >= len(toks):
+            raise ConfigError(f"dangling token {name!r} at end of config")
+        if toks[i + 1] != "=":
+            raise ConfigError(f"expected '=' after {name!r}")
+        if i + 2 >= len(toks) or toks[i + 2] == "=":
+            raise ConfigError(f"expected value after '{name} ='")
+        out.append((name, toks[i + 2]))
+        i += 3
+    return out
+
+
+def parse_config_file(path: str) -> ConfigPairs:
+    with open(path, "r") as f:
+        return parse_config_string(f.read())
+
+
+def parse_cli_overrides(argv: List[str]) -> ConfigPairs:
+    """Parse ``key=value`` command-line override arguments.
+
+    Mirrors the reference CLI behavior (cxxnet_main.cpp:93-108): every arg
+    containing ``=`` is appended after the config-file pairs so it wins for
+    scalar settings.
+    """
+    out: ConfigPairs = []
+    for arg in argv:
+        if "=" not in arg:
+            raise ConfigError(f"cannot parse CLI override {arg!r}; expected key=value")
+        k, v = arg.split("=", 1)
+        out.append((k.strip(), v.strip()))
+    return out

@@ -1,0 +1,4 @@
+from .data import DataBatch, DataIter, create_iterator, register_iter
+from . import proc  # noqa: F401  (register built-in iterators)
+
+__all__ = ["DataBatch", "DataIter", "create_iterator", "register_iter"]

@@ -1,0 +1,98 @@
+"""Data iterator protocol and factory.
+
+Reference: IIterator<DataBatch>/DataInst/DataBatch
+(/root/reference/src/io/data.h:19-183) and the config-ordered iterator
+chain factory (data.cpp:27-94). Batches are host numpy arrays in NHWC (flat
+nodes (n,1,1,k)); ``num_batch_padd`` marks trailing padded rows of the final
+partial batch so XLA always sees static shapes and metrics/losses mask the
+padding (SURVEY §7 "dynamic batch tail").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Type
+
+import numpy as np
+
+from ..config import ConfigPairs
+
+
+@dataclasses.dataclass
+class DataBatch:
+    data: np.ndarray                      # (batch, y, x, c) or (batch,1,1,n)
+    label: np.ndarray                     # (batch, label_width) float32
+    num_batch_padd: int = 0               # trailing rows that are padding
+    inst_index: Optional[np.ndarray] = None  # (batch,) instance ids
+    extra_data: List[np.ndarray] = dataclasses.field(default_factory=list)
+
+    @property
+    def batch_size(self) -> int:
+        return self.data.shape[0]
+
+
+class DataIter:
+    """Iterator protocol (reference IIterator, data.h:19-39)."""
+
+    def __init__(self, cfg: ConfigPairs):
+        self.cfg = cfg
+        for k, v in cfg:
+            self.set_param(k, v)
+
+    def set_param(self, name: str, val: str) -> None:
+        pass
+
+    def init(self) -> None:
+        pass
+
+    def before_first(self) -> None:
+        raise NotImplementedError
+
+    def next(self) -> Optional[DataBatch]:
+        """Return the next batch or None at end of epoch."""
+        raise NotImplementedError
+
+    def __iter__(self):
+        self.before_first()
+        while True:
+            b = self.next()
+            if b is None:
+                return
+            yield b
+
+
+ITER_REGISTRY: Dict[str, Type[DataIter]] = {}
+
+
+def register_iter(*names: str):
+    def deco(cls):
+        for n in names:
+            ITER_REGISTRY[n] = cls
+        return cls
+    return deco
+
+
+def create_iterator(cfg: ConfigPairs) -> DataIter:
+    """Build an iterator chain from one config section (reference
+    data.cpp:27-94): each ``iter = <type>`` entry creates an iterator wrapping
+    the previous one; every other pair is passed to all iterators in the
+    chain (each ignores settings it does not understand)."""
+    from . import proc  # noqa: F401  (registers decorators)
+    kinds = [v for k, v in cfg if k == "iter"]
+    params = [(k, v) for k, v in cfg if k != "iter"]
+    it: Optional[DataIter] = None
+    for kind in kinds:
+        if kind == "end":
+            continue
+        if kind not in ITER_REGISTRY:
+            raise ValueError(f"unknown iterator type {kind!r}")
+        cls = ITER_REGISTRY[kind]
+        if it is None:
+            it = cls(params)
+        else:
+            it = cls(params, base=it)   # decorator iterators take base
+        # init inner-to-outer so decorators always wrap a ready base
+        it.init()
+    if it is None:
+        raise ValueError("config section declares no iterator")
+    return it

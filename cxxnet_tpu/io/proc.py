@@ -1,0 +1,239 @@
+"""Processing iterators: batching, threaded prefetch, in-memory cache,
+synthetic data, CSV.
+
+Reference analogs:
+  * BatchAdaptIterator (iter_batch_proc-inl.hpp:17-129) — instance->batch
+    packing with round_batch wraparound and partial-batch padding;
+  * ThreadBufferIterator (iter_batch_proc-inl.hpp:132-220) — double-buffered
+    producer thread over whole batches, built on utils/thread_buffer.h;
+  * DenseBufferIterator (iter_mem_buffer-inl.hpp:17-78) — cache first N
+    batches in RAM and loop over them;
+  * CSVIterator (iter_csv-inl.hpp:14-112) — label_width leading columns.
+
+The synthetic iterator is this framework's deterministic stand-in for the
+examples-as-tests strategy (SURVEY §4): separable gaussian clusters so unit
+tests can assert that training actually learns.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+from .data import DataBatch, DataIter, register_iter
+from . import iter_mnist  # noqa: F401  (register mnist)
+
+
+@register_iter("threadbuffer")
+class ThreadBufferIterator(DataIter):
+    """Background-thread prefetch with a bounded queue. The reference uses a
+    semaphore-handshake double buffer (thread_buffer.h:22-205); a queue of
+    depth ``buffer_size`` generalizes it (depth 1 == double buffering)."""
+
+    def set_param(self, name, val):
+        if name == "buffer_size":
+            self.buffer_size = int(val)
+
+    def __init__(self, cfg, base: DataIter):
+        self.buffer_size = 2
+        self.base = base
+        self._queue: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        super().__init__(cfg)
+
+    def init(self):
+        pass
+
+    def _producer(self):
+        self.base.before_first()
+        while not self._stop.is_set():
+            batch = self.base.next()
+            self._queue.put(batch)
+            if batch is None:
+                return
+
+    def before_first(self):
+        # tear down any in-flight producer, then restart
+        if self._thread is not None and self._thread.is_alive():
+            self._stop.set()
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join()
+        self._stop.clear()
+        self._queue = queue.Queue(maxsize=self.buffer_size)
+        self._thread = threading.Thread(target=self._producer, daemon=True)
+        self._thread.start()
+
+    def next(self):
+        if self._queue is None:
+            self.before_first()
+        return self._queue.get()
+
+
+@register_iter("membuffer")
+class DenseBufferIterator(DataIter):
+    """Cache the first max_buffer batches in RAM, then loop over them."""
+
+    def set_param(self, name, val):
+        if name == "max_buffer":
+            self.max_buffer = int(val)
+
+    def __init__(self, cfg, base: DataIter):
+        self.max_buffer = 16
+        self.base = base
+        self._cache: List[DataBatch] = []
+        self._filled = False
+        self._pos = 0
+        super().__init__(cfg)
+
+    def before_first(self):
+        self._pos = 0
+        if not self._filled:
+            self.base.before_first()
+            for _ in range(self.max_buffer):
+                b = self.base.next()
+                if b is None:
+                    break
+                self._cache.append(b)
+            self._filled = True
+
+    def next(self):
+        if self._pos >= len(self._cache):
+            return None
+        b = self._cache[self._pos]
+        self._pos += 1
+        return b
+
+
+@register_iter("csv")
+class CSVIterator(DataIter):
+    """CSV with label_width leading label columns then features
+    (iter_csv-inl.hpp:14-112); optional input_shape to reshape features."""
+
+    def set_param(self, name, val):
+        if name == "filename" or name == "path_csv":
+            self.filename = val
+        elif name == "label_width":
+            self.label_width = int(val)
+        elif name == "batch_size":
+            self.batch_size = int(val)
+        elif name == "shuffle":
+            self.shuffle = int(val)
+        elif name == "input_shape":
+            self.input_shape = tuple(int(x) for x in val.split(","))
+        elif name == "seed_data":
+            self.seed = int(val)
+
+    def __init__(self, cfg):
+        self.filename = ""
+        self.label_width = 1
+        self.batch_size = 128
+        self.shuffle = 0
+        self.input_shape = None
+        self.seed = 0
+        super().__init__(cfg)
+
+    def init(self):
+        raw = np.loadtxt(self.filename, delimiter=",", dtype=np.float32,
+                         ndmin=2)
+        self.labels = raw[:, :self.label_width]
+        feats = raw[:, self.label_width:]
+        n = feats.shape[0]
+        if self.input_shape and not (self.input_shape[0] == 1 and
+                                     self.input_shape[1] == 1):
+            c, y, x = self.input_shape
+            self.data = feats.reshape(n, c, y, x).transpose(0, 2, 3, 1).copy()
+        else:
+            self.data = feats.reshape(n, 1, 1, -1)
+        self._order = np.arange(n)
+        self._rng = np.random.RandomState(self.seed)
+        self.before_first()
+
+    def before_first(self):
+        if self.shuffle:
+            self._rng.shuffle(self._order)
+        self._pos = 0
+
+    def next(self):
+        n = self.data.shape[0]
+        bs = self.batch_size
+        if self._pos >= n:
+            return None
+        idx = self._order[self._pos:self._pos + bs]
+        padd = 0
+        if len(idx) < bs:
+            padd = bs - len(idx)
+            idx = np.concatenate([idx, np.repeat(idx[-1:], padd)])
+        self._pos += bs
+        return DataBatch(data=self.data[idx], label=self.labels[idx],
+                         num_batch_padd=padd,
+                         inst_index=idx.astype(np.int64))
+
+
+@register_iter("synthetic")
+class SyntheticIterator(DataIter):
+    """Deterministic gaussian-cluster classification data for tests and IO-free
+    benchmarking (plays the role of the reference's test_io/test_skipread
+    harness, iter_batch_proc-inl.hpp:21,69)."""
+
+    def set_param(self, name, val):
+        if name == "num_inst":
+            self.num_inst = int(val)
+        elif name == "batch_size":
+            self.batch_size = int(val)
+        elif name == "num_class":
+            self.num_class = int(val)
+        elif name == "input_shape":
+            self.input_shape = tuple(int(x) for x in val.split(","))
+        elif name == "seed_data":
+            self.seed = int(val)
+        elif name == "label_width":
+            self.label_width = int(val)
+
+    def __init__(self, cfg):
+        self.num_inst = 512
+        self.batch_size = 128
+        self.num_class = 10
+        self.input_shape = (1, 1, 32)
+        self.seed = 7
+        self.label_width = 1
+        super().__init__(cfg)
+
+    def init(self):
+        rng = np.random.RandomState(self.seed)
+        c, y, x = self.input_shape
+        dim = c * y * x
+        centers = rng.randn(self.num_class, dim).astype(np.float32) * 2.0
+        lab = rng.randint(0, self.num_class, size=self.num_inst)
+        feats = centers[lab] + 0.5 * rng.randn(self.num_inst, dim).astype(np.float32)
+        if c == 1 and y == 1:
+            self.data = feats.reshape(self.num_inst, 1, 1, x)
+        else:
+            self.data = feats.reshape(self.num_inst, c, y, x) \
+                .transpose(0, 2, 3, 1).copy()
+        self.labels = np.tile(lab.astype(np.float32)[:, None],
+                              (1, self.label_width))
+        self.before_first()
+
+    def before_first(self):
+        self._pos = 0
+
+    def next(self):
+        if self._pos >= self.num_inst:
+            return None
+        bs = self.batch_size
+        idx = np.arange(self._pos, min(self._pos + bs, self.num_inst))
+        padd = 0
+        if len(idx) < bs:
+            padd = bs - len(idx)
+            idx = np.concatenate([idx, np.repeat(idx[-1:], padd)])
+        self._pos += bs
+        return DataBatch(data=self.data[idx], label=self.labels[idx],
+                         num_batch_padd=padd, inst_index=idx.astype(np.int64))

@@ -1,0 +1,85 @@
+"""Layer registry and factory.
+
+Reference analog: CreateLayer_ switch (/root/reference/src/layer/
+layer_impl-inl.hpp:36-81) mapping every type enum to a class, plus the
+pairtest composite (pairtest_layer-inl.hpp:15-203) used as the reference's
+runtime correctness harness.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from ..config import ConfigPairs
+from ..graph import LayerSpec
+from .base import LAYER_REGISTRY, ApplyCtx, Layer, Shape3, register_layer
+from . import core, conv, norm, loss  # noqa: F401  (populate registry)
+
+
+class PairTestLayer(Layer):
+    """Run master & slave implementations side-by-side
+    (pairtest_layer-inl.hpp): outputs the master's result and records the
+    max |master - slave| divergence in layer state under ``diff`` so tests
+    (and users) can assert the two implementations agree.
+    """
+    has_params = True
+    has_state = True
+
+    def __init__(self, spec: LayerSpec, global_cfg: ConfigPairs):
+        master_t, slave_t = spec.pairtest
+        mspec = LayerSpec(type=master_t, name=spec.name + ".master",
+                          nindex_in=spec.nindex_in, nindex_out=spec.nindex_out,
+                          cfg=list(spec.cfg))
+        sspec = LayerSpec(type=slave_t, name=spec.name + ".slave",
+                          nindex_in=spec.nindex_in, nindex_out=spec.nindex_out,
+                          cfg=list(spec.cfg))
+        self.master = LAYER_REGISTRY[master_t](mspec, global_cfg)
+        self.slave = LAYER_REGISTRY[slave_t](sspec, global_cfg)
+        super().__init__(spec, global_cfg)
+
+    def infer_shapes(self, in_shapes):
+        out_m = self.master.infer_shapes(in_shapes)
+        out_s = self.slave.infer_shapes(in_shapes)
+        if out_m != out_s:
+            raise ValueError(
+                f"pairtest {self.name!r}: master/slave shapes disagree "
+                f"{out_m} vs {out_s}")
+        return out_m
+
+    def init_params(self, key, in_shapes):
+        # mirror weights: slave gets the master's params (reference syncs via
+        # Get/SetWeightVisitor)
+        p = self.master.init_params(key, in_shapes)
+        return {"master": p, "slave": dict(p)}
+
+    def init_state(self, in_shapes):
+        return {"master": self.master.init_state(in_shapes),
+                "slave": self.slave.init_state(in_shapes),
+                "diff": jnp.zeros((), jnp.float32)}
+
+    def apply(self, params, state, inputs, ctx):
+        out_m, st_m = self.master.apply(params.get("master", {}),
+                                        state["master"], inputs, ctx)
+        out_s, st_s = self.slave.apply(params.get("slave", {}),
+                                       state["slave"], inputs, ctx)
+        diff = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32)))
+             for a, b in zip(out_m, out_s)]))
+        return out_m, {"master": st_m, "slave": st_s, "diff": diff}
+
+
+def create_layer(spec: LayerSpec, global_cfg: ConfigPairs) -> Layer:
+    """Factory (reference layer_impl-inl.hpp:36-81). ``share`` specs are
+    resolved by the model builder (the primary layer object is reused), so
+    they never reach this factory."""
+    if spec.type == "pairtest":
+        return PairTestLayer(spec, global_cfg)
+    if spec.type not in LAYER_REGISTRY:
+        raise ValueError(f"unknown layer type: {spec.type!r}")
+    return LAYER_REGISTRY[spec.type](spec, global_cfg)
+
+
+__all__ = ["Layer", "ApplyCtx", "LayerSpec", "create_layer", "LAYER_REGISTRY",
+           "register_layer", "PairTestLayer"]

@@ -1,0 +1,229 @@
+"""Convolution, pooling, and LRN layers, TPU-native.
+
+The reference implements conv as im2col + grouped GEMM with memory-bounded
+chunking (convolution_layer-inl.hpp:13-231) and ships a cuDNN specialization;
+pooling as mshadow pool/unpool expressions (pooling_layer-inl.hpp) with
+*ceil-mode* output shapes; LRN as a cross-channel chpool expression
+(lrn_layer-inl.hpp). Here conv lowers to ``lax.conv_general_dilated`` in NHWC
+(XLA tiles it onto the MXU directly — no im2col staging or temp_col_max
+chunking needed), pooling to ``lax.reduce_window`` with explicit asymmetric
+padding to reproduce ceil-mode shapes, and LRN to a pad+slice window sum that
+XLA fuses.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .base import ApplyCtx, Layer, Shape3, is_flat, register_layer
+
+
+@register_layer("conv")
+class ConvolutionLayer(Layer):
+    """2-D convolution with groups (convolution_layer-inl.hpp:13-231).
+
+    Weight layout HWIO ``(kh, kw, cin/group, cout)``; output spatial size is
+    floor((in + 2p - k)/stride) + 1 as in the reference (:174-178).
+    """
+    has_params = True
+
+    def infer_shapes(self, in_shapes: List[Shape3]) -> List[Shape3]:
+        self.check_n(in_shapes, 1, 1)
+        c, y, x = in_shapes[0]
+        hp = self.hp
+        if hp.num_channel <= 0:
+            raise ValueError(f"conv {self.name!r}: nchannel must be set")
+        if hp.kernel_height <= 0 or hp.kernel_width <= 0:
+            raise ValueError(f"conv {self.name!r}: kernel_size must be set")
+        if c % hp.num_group or hp.num_channel % hp.num_group:
+            raise ValueError(f"conv {self.name!r}: channels must divide ngroup")
+        if hp.kernel_height > y or hp.kernel_width > x:
+            raise ValueError(f"conv {self.name!r}: kernel size exceeds input")
+        oy = (y + 2 * hp.pad_y - hp.kernel_height) // hp.stride + 1
+        ox = (x + 2 * hp.pad_x - hp.kernel_width) // hp.stride + 1
+        self._cin = c
+        return [(hp.num_channel, oy, ox)]
+
+    def init_params(self, key, in_shapes):
+        hp = self.hp
+        kh, kw = hp.kernel_height, hp.kernel_width
+        cin_g = self._cin // hp.num_group
+        shape = (kh, kw, cin_g, hp.num_channel)
+        fan_in = cin_g * kh * kw
+        fan_out = (hp.num_channel // hp.num_group) * kh * kw
+        params = {"wmat": hp.init_weight(key, shape, fan_in, fan_out)}
+        if not hp.no_bias:
+            params["bias"] = jnp.full((hp.num_channel,), hp.init_bias, hp.dtype)
+        return params
+
+    def apply(self, params, state, inputs, ctx):
+        hp = self.hp
+        x = inputs[0].astype(ctx.compute_dtype)
+        w = params["wmat"].astype(ctx.compute_dtype)
+        y = lax.conv_general_dilated(
+            x, w,
+            window_strides=(hp.stride, hp.stride),
+            padding=((hp.pad_y, hp.pad_y), (hp.pad_x, hp.pad_x)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=hp.num_group,
+            preferred_element_type=jnp.float32)
+        if "bias" in params:
+            y = y + params["bias"]
+        return [y], state
+
+
+def _pool_geometry(size: int, k: int, s: int, p: int):
+    """Ceil-mode pooling geometry (pooling_layer-inl.hpp:111-120):
+    out = min(size + 2p - k + s - 1, size + 2p - 1) // s + 1.
+    Returns (out, extra) where extra is additional trailing pad needed so a
+    VALID reduce_window over (p, p + extra) padding yields ``out``."""
+    out = min(size + 2 * p - k + s - 1, size + 2 * p - 1) // s + 1
+    needed = (out - 1) * s + k
+    extra = max(0, needed - (size + 2 * p))
+    return out, extra
+
+
+class _PoolingLayer(Layer):
+    """Max/avg/sum pooling (pooling_layer-inl.hpp:17-135). ``avg`` divides by
+    k*k including padded cells, matching the reference's pool-then-scale."""
+    reducer = "max"          # max | sum
+    scale_avg = False
+    pre_relu = False         # relu_max_pooling fusion (layer_impl-inl.hpp:58)
+
+    def infer_shapes(self, in_shapes):
+        self.check_n(in_shapes, 1, 1)
+        c, y, x = in_shapes[0]
+        hp = self.hp
+        if hp.kernel_height <= 0 or hp.kernel_width <= 0:
+            raise ValueError(f"{self.spec.type} {self.name!r}: must set kernel_size")
+        if hp.kernel_width > x or hp.kernel_height > y:
+            raise ValueError(f"{self.spec.type} {self.name!r}: kernel exceeds input")
+        oy, self._extra_y = _pool_geometry(y, hp.kernel_height, hp.stride, hp.pad_y)
+        ox, self._extra_x = _pool_geometry(x, hp.kernel_width, hp.stride, hp.pad_x)
+        return [(c, oy, ox)]
+
+    def apply(self, params, state, inputs, ctx):
+        hp = self.hp
+        x = inputs[0]
+        if self.pre_relu:
+            x = jax.nn.relu(x)
+        if self.reducer == "max":
+            init, op = -jnp.inf, lax.max
+        else:
+            init, op = 0.0, lax.add
+        pad = ((0, 0),
+               (hp.pad_y, hp.pad_y + self._extra_y),
+               (hp.pad_x, hp.pad_x + self._extra_x),
+               (0, 0))
+        y = lax.reduce_window(
+            x, jnp.asarray(init, x.dtype), op,
+            window_dimensions=(1, hp.kernel_height, hp.kernel_width, 1),
+            window_strides=(1, hp.stride, hp.stride, 1),
+            padding=pad)
+        if self.scale_avg:
+            y = y * (1.0 / (hp.kernel_height * hp.kernel_width))
+        return [y], state
+
+
+@register_layer("max_pooling")
+class MaxPoolingLayer(_PoolingLayer):
+    reducer = "max"
+
+
+@register_layer("sum_pooling")
+class SumPoolingLayer(_PoolingLayer):
+    reducer = "sum"
+
+
+@register_layer("avg_pooling")
+class AvgPoolingLayer(_PoolingLayer):
+    reducer = "sum"
+    scale_avg = True
+
+
+@register_layer("relu_max_pooling")
+class ReluMaxPoolingLayer(_PoolingLayer):
+    reducer = "max"
+    pre_relu = True
+
+
+@register_layer("insanity_max_pooling")
+class InsanityPoolingLayer(_PoolingLayer):
+    """Stochastic pooling (insanity_pooling_layer-inl.hpp:223-286): at train
+    time pick a cell of each window with probability proportional to its
+    (relu'd) activation; at eval fall back to max pooling over relu.
+    """
+    reducer = "max"
+    pre_relu = True
+    has_state = False
+
+    def apply(self, params, state, inputs, ctx):
+        if not ctx.train:
+            return super().apply(params, state, inputs, ctx)
+        hp = self.hp
+        x = jax.nn.relu(inputs[0])
+        b, y, xw, c = x.shape
+        kh, kw, s = hp.kernel_height, hp.kernel_width, hp.stride
+        oy, ey = _pool_geometry(y, kh, s, hp.pad_y)
+        ox, ex = _pool_geometry(xw, kw, s, hp.pad_x)
+        xp = jnp.pad(x, ((0, 0), (hp.pad_y, hp.pad_y + ey),
+                         (hp.pad_x, hp.pad_x + ex), (0, 0)))
+        # gather all windows: (b, oy, ox, kh*kw, c)
+        cells = jnp.stack(
+            [xp[:, dy:dy + oy * s:s, dx:dx + ox * s:s, :]
+             for dy in range(kh) for dx in range(kw)], axis=3)
+        total = jnp.sum(cells, axis=3, keepdims=True)
+        # uniform fallback when the window is all zeros
+        probs = jnp.where(total > 0, cells / jnp.maximum(total, 1e-12),
+                          1.0 / (kh * kw))
+        u = jax.random.uniform(ctx.rng, (b, oy, ox, 1, c), x.dtype)
+        cdf = jnp.cumsum(probs, axis=3)
+        idx = jnp.sum((u > cdf).astype(jnp.int32), axis=3, keepdims=True)
+        idx = jnp.clip(idx, 0, kh * kw - 1)
+        out = jnp.take_along_axis(cells, idx, axis=3)[:, :, :, 0, :]
+        return [out], state
+
+
+@register_layer("lrn")
+class LRNLayer(Layer):
+    """AlexNet-style cross-channel local response normalization
+    (lrn_layer-inl.hpp:12-90): out = in * (knorm + alpha/n * window_sum(in^2))^-beta
+    with a centered channel window of ``local_size``.
+    """
+
+    def set_param(self, name, val):
+        if name == "local_size":
+            self.nsize = int(val)
+        elif name == "alpha":
+            self.alpha = float(val)
+        elif name == "beta":
+            self.beta = float(val)
+        elif name == "knorm":
+            self.knorm = float(val)
+
+    def __init__(self, spec, global_cfg):
+        self.nsize = 3
+        self.alpha = 0.001
+        self.beta = 0.75
+        self.knorm = 1.0
+        super().__init__(spec, global_cfg)
+
+    def infer_shapes(self, in_shapes):
+        self.check_n(in_shapes, 1, 1)
+        return [in_shapes[0]]
+
+    def apply(self, params, state, inputs, ctx):
+        x = inputs[0]
+        sq = jnp.square(x)
+        half = self.nsize // 2
+        # window sum over channels via pad + strided slice sum; unrolled
+        # python loop over the (small, static) window lets XLA fuse it all
+        padded = jnp.pad(sq, ((0, 0), (0, 0), (0, 0), (half, self.nsize - 1 - half)))
+        c = x.shape[-1]
+        win = sum(padded[..., i:i + c] for i in range(self.nsize))
+        norm = self.knorm + (self.alpha / self.nsize) * win
+        return [x * jnp.power(norm, -self.beta)], state

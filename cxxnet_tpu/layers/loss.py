@@ -1,0 +1,114 @@
+"""Loss layers: softmax, lp_loss/l2_loss, multi_logistic.
+
+Reference: /root/reference/src/layer/loss/ — self-loop layers whose Forward
+writes predictions into the node and whose Backprop overwrites it with the
+gradient scaled by grad_scale/(batch_size*update_period)
+(loss_layer_base-inl.hpp:55-62). Here each loss layer's forward produces the
+prediction node (softmax probabilities / sigmoid / identity) and separately
+defines a scalar ``loss`` whose jax.grad reproduces exactly those hand-set
+gradients: e.g. d/dlogits of mean cross-entropy = (p - onehot)/batch, matching
+SoftmaxLayer::SetGradCPU (softmax_layer-inl.hpp:24-32) with the same scaling.
+
+``target`` binds the layer to a named label slice (multi-label via
+``label_vec[a,b)=name``); padded batch rows are excluded through ``mask``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import Layer, Shape3, register_layer
+
+
+class LossLayerBase(Layer):
+    is_loss = True
+
+    def set_param(self, name, val):
+        if name == "target":
+            self.target = val
+        elif name == "grad_scale":
+            self.grad_scale = float(val)
+
+    def __init__(self, spec, global_cfg):
+        self.target = "label"
+        self.grad_scale = 1.0
+        super().__init__(spec, global_cfg)
+        if spec.nindex_in != spec.nindex_out:
+            raise ValueError(f"{spec.type} is a self-loop layer: use layer[+0]")
+
+    def infer_shapes(self, in_shapes):
+        self.check_n(in_shapes, 1, 1)
+        return [in_shapes[0]]
+
+    def _mean(self, per_example: jax.Array, mask: jax.Array) -> jax.Array:
+        """grad_scale-weighted mean over the *global* batch.
+
+        ``mask`` zeroes padded rows; division is by the full batch size like
+        the reference (which scales by 1/batch_size regardless of padding —
+        padded rows there carry zero gradient because their labels are real
+        duplicates only in round_batch mode; we mask them outright).
+        """
+        return self.grad_scale * jnp.sum(per_example * mask) / per_example.shape[0]
+
+
+@register_layer("softmax")
+class SoftmaxLayer(LossLayerBase):
+    """Softmax + cross-entropy (loss/softmax_layer-inl.hpp:13-34).
+    Node output = probabilities; label = class index column."""
+
+    def apply(self, params, state, inputs, ctx):
+        x = inputs[0]
+        logits = x.reshape(x.shape[0], -1)
+        probs = jax.nn.softmax(logits, axis=-1)
+        return [probs.reshape(x.shape)], state
+
+    def loss(self, outputs, label, mask):
+        probs = outputs[0].reshape(outputs[0].shape[0], -1)
+        idx = label[:, 0].astype(jnp.int32)
+        logp = jnp.log(jnp.maximum(
+            jnp.take_along_axis(probs, idx[:, None], axis=1)[:, 0], 1e-30))
+        return self._mean(-logp, mask)
+
+
+@register_layer("lp_loss", "l2_loss")
+class LpLossLayer(LossLayerBase):
+    """Elementwise L_p regression loss (loss/lp_loss_layer-inl.hpp:13-43).
+    Forward is identity; loss = sum_j |pred_j - label_j|^p per example, whose
+    gradient is the reference's p*|d|^(p-1)*sign(d)."""
+
+    def set_param(self, name, val):
+        super().set_param(name, val)
+        if name == "p":
+            self.p = float(val)
+
+    def __init__(self, spec, global_cfg):
+        self.p = 2.0
+        super().__init__(spec, global_cfg)
+
+    def apply(self, params, state, inputs, ctx):
+        return [inputs[0]], state
+
+    def loss(self, outputs, label, mask):
+        pred = outputs[0].reshape(outputs[0].shape[0], -1)
+        d = pred - label
+        per = jnp.sum(jnp.power(jnp.abs(d), self.p), axis=1)
+        return self._mean(per, mask)
+
+
+@register_layer("multi_logistic")
+class MultiLogisticLayer(LossLayerBase):
+    """Independent sigmoid + binary cross-entropy per output
+    (loss/multi_logistic_layer-inl.hpp:13-37). Node output = sigmoid(x);
+    gradient of the summed BCE w.r.t. logits is (p - y), matching SetGradCPU.
+    """
+
+    def apply(self, params, state, inputs, ctx):
+        x = inputs[0]
+        return [jax.nn.sigmoid(x)], state
+
+    def loss(self, outputs, label, mask):
+        p = outputs[0].reshape(outputs[0].shape[0], -1)
+        p = jnp.clip(p, 1e-7, 1.0 - 1e-7)
+        per = -jnp.sum(label * jnp.log(p) + (1 - label) * jnp.log(1 - p), axis=1)
+        return self._mean(per, mask)

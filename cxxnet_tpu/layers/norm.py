@@ -1,0 +1,103 @@
+"""Batch normalization (batch_norm / batch_norm_no_ma).
+
+Reference: BatchNormLayer<xpu, moving_avg>
+(/root/reference/src/layer/batch_norm_layer-inl.hpp:13-243). Semantics kept:
+  * stats are per-channel for conv nodes, per-feature for flat nodes, computed
+    over all remaining axes (biased variance, scale = channel/total);
+  * gamma is visited under tag "wmat" and beta under "bias" (:29-32), so lr/wd
+    scoping follows those tags;
+  * ``batch_norm`` keeps running stats with ``bn_momentum`` (train-time EMA,
+    used at eval); ``batch_norm_no_ma`` recomputes batch stats at eval;
+  * running stats initialize to zero (:48-52) — reference parity;
+  * stats are computed on the *local* (per-device) batch slice, matching the
+    reference's per-GPU BN (no cross-replica sync; see SURVEY §7 risks). A
+    cross-replica psum variant can be layered on for TPU when wanted.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import Layer, is_flat, register_layer
+
+
+class _BatchNormBase(Layer):
+    moving_avg = True
+    has_params = True
+
+    def set_param(self, name, val):
+        if name == "init_slope":
+            self.init_slope = float(val)
+        elif name == "eps":
+            self.eps = float(val)
+        elif name == "bn_momentum":
+            self.bn_momentum = float(val)
+
+    def __init__(self, spec, global_cfg):
+        self.init_slope = 1.0
+        self.eps = 1e-10
+        self.bn_momentum = 0.9
+        super().__init__(spec, global_cfg)
+
+    @property
+    def has_state(self):
+        return self.moving_avg
+
+    def infer_shapes(self, in_shapes):
+        self.check_n(in_shapes, 1, 1)
+        s = in_shapes[0]
+        self._channel = s[2] if is_flat(s) else s[0]
+        return [s]
+
+    def init_params(self, key, in_shapes):
+        return {
+            "wmat": jnp.full((self._channel,), self.init_slope, self.hp.dtype),
+            "bias": jnp.full((self._channel,), self.hp.init_bias, self.hp.dtype),
+        }
+
+    def init_state(self, in_shapes):
+        if not self.moving_avg:
+            return {}
+        return {
+            "running_exp": jnp.zeros((self._channel,), jnp.float32),
+            "running_var": jnp.zeros((self._channel,), jnp.float32),
+        }
+
+    def apply(self, params, state, inputs, ctx):
+        x = inputs[0]
+        axes = (0, 1, 2)   # NHWC: stats over batch+spatial, per channel;
+        # flat nodes are (b,1,1,n) so this is per-feature over the batch
+        slope, bias = params["wmat"], params["bias"]
+        if ctx.train:
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.mean(jnp.square(xf - mean), axis=axes)
+            inv = jax.lax.rsqrt(var + self.eps)
+            out = (x - mean) * inv * slope + bias
+            if self.moving_avg:
+                m = self.bn_momentum
+                state = {
+                    "running_exp": state["running_exp"] * m + mean * (1 - m),
+                    "running_var": state["running_var"] * m + var * (1 - m),
+                }
+            return [out.astype(x.dtype)], state
+        if self.moving_avg:
+            mean, var = state["running_exp"], state["running_var"]
+        else:
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.mean(jnp.square(xf - mean), axis=axes)
+        inv = jax.lax.rsqrt(var + self.eps)
+        out = x * (slope * inv) + (bias - slope * mean * inv)
+        return [out.astype(x.dtype)], state
+
+
+@register_layer("batch_norm")
+class BatchNormLayer(_BatchNormBase):
+    moving_avg = True
+
+
+@register_layer("batch_norm_no_ma")
+class BatchNormNoMALayer(_BatchNormBase):
+    moving_avg = False

@@ -1,0 +1,226 @@
+"""Task driver CLI: train / finetune / pred / extract_feature / get_weight.
+
+Reference: CXXNetLearnTask (/root/reference/src/cxxnet_main.cpp:26-575) —
+config file + ``key=value`` CLI overrides, order-sensitive iterator sections
+(``data = train`` .. ``iter = end``), round loop with periodic ``%04d.model``
+checkpoints, ``continue=1`` auto-resume from the newest checkpoint, and task
+dispatch (Run, :113-116). Same surface here:
+
+    python -m cxxnet_tpu.main config.conf [key=value ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .config import ConfigPairs, parse_cli_overrides, parse_config_file
+from .graph import global_param
+from .io.data import DataBatch, create_iterator
+from .trainer import Trainer
+from . import checkpoint as ckpt
+
+_SECTION_KEYS = ("data", "eval", "pred")
+
+
+def split_sections(cfg: ConfigPairs):
+    """Separate iterator sections from global config
+    (reference CreateIterators, cxxnet_main.cpp:266-315)."""
+    global_cfg: ConfigPairs = []
+    sections: List[Tuple[str, str, ConfigPairs]] = []  # (kind, name, pairs)
+    cur: Optional[List] = None
+    for name, val in cfg:
+        if name in _SECTION_KEYS:
+            cur = []
+            sections.append((name, val, cur))
+            continue
+        if name == "iter":
+            if cur is None:
+                continue
+            if val == "end":
+                cur = None
+            else:
+                cur.append((name, val))
+            continue
+        if cur is not None:
+            cur.append((name, val))
+        else:
+            global_cfg.append((name, val))
+    return global_cfg, sections
+
+
+class LearnTask:
+    def __init__(self, cfg: ConfigPairs):
+        self.cfg = cfg
+        self.global_cfg, self.sections = split_sections(cfg)
+        gp = lambda n, d: global_param(self.global_cfg, n, d)
+        self.task = gp("task", "train")
+        self.net_type = gp("net_type", "")
+        self.num_round = int(gp("num_round", "10"))
+        self.start_counter = int(gp("start_counter", "0"))
+        self.print_step = int(gp("print_step", "100"))
+        self.save_period = int(gp("save_period", "1"))
+        self.save_model = int(gp("save_model", "1"))
+        self.model_dir = gp("model_dir", "./models")
+        self.model_in = gp("model_in", "NULL")
+        self.continue_training = int(gp("continue", "0"))
+        self.extract_node_name = gp("extract_node_name", "top")
+        self.name_pred = gp("name_pred", "pred.txt")
+        self.silent = int(gp("silent", "0"))
+        self.trainer = Trainer(self.global_cfg)
+
+    # -- iterators ---------------------------------------------------------
+    def _make_iter(self, pairs: ConfigPairs):
+        # globals (batch_size, input_shape, ...) reach every iterator, then
+        # the section-local pairs override
+        return create_iterator(self.global_cfg + pairs)
+
+    def train_iter(self):
+        for kind, name, pairs in self.sections:
+            if kind == "data":
+                return self._make_iter(pairs)
+        return None
+
+    def eval_iters(self):
+        return [(name, self._make_iter(pairs))
+                for kind, name, pairs in self.sections if kind == "eval"]
+
+    def pred_iter(self):
+        for kind, name, pairs in self.sections:
+            if kind == "pred":
+                return self._make_iter(pairs)
+        return None
+
+    # -- model init --------------------------------------------------------
+    def _init_model(self) -> None:
+        tr = self.trainer
+        if self.continue_training:
+            latest = ckpt.find_latest(self.model_dir)
+            if latest is not None:
+                r, path = latest
+                tr.init_model()
+                tr.load_model(path)
+                self.start_counter = r + 1
+                if not self.silent:
+                    print(f"continuing from round {r} ({path})")
+                return
+        if self.model_in != "NULL":
+            tr.init_model()
+            if self.task == "finetune":
+                tr.copy_model_from(self.model_in)
+            else:
+                tr.load_model(self.model_in)
+                self.start_counter = tr.round_counter + 1
+            return
+        tr.init_model()
+
+    # -- tasks -------------------------------------------------------------
+    def run(self) -> None:
+        if self.task in ("train", "finetune"):
+            self.task_train()
+        elif self.task == "pred":
+            self.task_predict()
+        elif self.task in ("extract", "extract_feature"):
+            self.task_extract()
+        elif self.task == "get_weight":
+            self.task_get_weight()
+        else:
+            raise ValueError(f"unknown task {self.task!r}")
+
+    def task_train(self) -> None:
+        tr = self.trainer
+        self._init_model()
+        itr_train = self.train_iter()
+        if itr_train is None:
+            raise ValueError("no training data section (data = ...) in config")
+        evals = self.eval_iters()
+        os.makedirs(self.model_dir, exist_ok=True)
+        start = time.time()
+        for r in range(self.start_counter, self.num_round):
+            tr.start_round(r)
+            batch_count = 0
+            for batch in itr_train:
+                tr.update(batch)
+                batch_count += 1
+                if self.print_step and batch_count % self.print_step == 0 \
+                        and not self.silent:
+                    elapsed = int(time.time() - start)
+                    print(f"round {r:8d}:[{batch_count:8d}] {elapsed} sec "
+                          f"elapsed, loss={tr.last_loss:.6f}", flush=True)
+            line = f"round {r:8d}:[{int(time.time() - start)} sec]"
+            if tr.eval_train:
+                line += tr.train_metric_report("train")
+            for name, itr in evals:
+                line += tr.evaluate(itr, name)
+            print(line, flush=True)
+            # save_period == 0 means "never save periodically"
+            # (reference cxxnet_main.cpp:220)
+            if self.save_model and self.save_period \
+                    and (r + 1) % self.save_period == 0:
+                tr.save_model(ckpt.model_path(self.model_dir, r))
+        if self.save_model:
+            final = ckpt.model_path(self.model_dir, self.num_round - 1)
+            if not os.path.exists(final):
+                tr.save_model(final)
+
+    def task_predict(self) -> None:
+        tr = self.trainer
+        self._init_model()
+        itr = self.pred_iter() or self.train_iter()
+        if itr is None:
+            raise ValueError("no pred/data section in config")
+        with open(self.name_pred, "w") as f:
+            for batch in itr:
+                for v in tr.predict(batch):
+                    f.write(f"{float(v):g}\n")
+        if not self.silent:
+            print(f"finished prediction, write into {self.name_pred}")
+
+    def task_extract(self) -> None:
+        tr = self.trainer
+        self._init_model()
+        itr = self.pred_iter() or self.train_iter()
+        if itr is None:
+            raise ValueError("no pred/data section in config")
+        with open(self.name_pred, "w") as f:
+            for batch in itr:
+                feats = tr.extract_feature(batch, self.extract_node_name)
+                for row in feats:
+                    f.write(" ".join(f"{float(v):g}" for v in row) + "\n")
+        if not self.silent:
+            print(f"finished feature extraction, write into {self.name_pred}")
+
+    def task_get_weight(self) -> None:
+        tr = self.trainer
+        self._init_model()
+        layer = global_param(self.global_cfg, "weight_layer", "")
+        tag = global_param(self.global_cfg, "weight_tag", "wmat")
+        if not layer:
+            raise ValueError("get_weight requires weight_layer=<name>")
+        w = tr.get_weight(layer, tag)
+        with open(self.name_pred, "w") as f:
+            f.write(" ".join(str(d) for d in w.shape) + "\n")
+            for row in w.reshape(w.shape[0], -1):
+                f.write(" ".join(f"{float(v):g}" for v in row) + "\n")
+        if not self.silent:
+            print(f"weight {layer}:{tag} -> {self.name_pred}")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    ap = argparse.ArgumentParser(
+        prog="cxxnet_tpu",
+        description="TPU-native cxxnet-capability trainer")
+    ap.add_argument("config", help="config file (key=value dialect)")
+    ap.add_argument("overrides", nargs="*", help="key=value overrides")
+    args = ap.parse_args(argv)
+    cfg = parse_config_file(args.config) + parse_cli_overrides(args.overrides)
+    LearnTask(cfg).run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,166 @@
+"""Network: compile a NetGraph into a pure, jittable forward function.
+
+TPU-native replacement for the reference's NeuralNet<xpu> DAG executor
+(/root/reference/src/nnet/neural_net-inl.hpp:23-318). The reference allocates
+per-device Node buffers, runs layer->Forward over connections in order, and
+hand-written layer->Backprop in reverse (activations doubling as gradient
+storage). Here the whole graph is one pure function of (params, state, batch):
+node values are a functional list, losses are summed into a scalar, and
+``jax.grad`` of that scalar reproduces every hand-written backward pass.
+Shared layers (kSharedLayer weight tying, neural_net-inl.hpp:259-265) reuse
+the primary layer's parameter subtree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .config import ConfigPairs
+from .graph import NetGraph, global_param
+from .layers import ApplyCtx, Layer, create_layer
+from .layers.base import Shape3, is_flat, to_nhwc
+
+Params = Dict[str, Dict[str, jax.Array]]
+NetState = Dict[str, Any]
+
+
+@dataclasses.dataclass
+class ForwardResult:
+    loss: jax.Array                       # scalar total loss
+    state: NetState                       # updated layer state (BN stats, ...)
+    nodes: Optional[Dict[str, jax.Array]]  # node name -> value (if captured)
+    out: jax.Array                        # value of the last node (predictions)
+
+
+class Network:
+    """Static graph + layer objects; all runtime data flows through apply."""
+
+    def __init__(self, graph: NetGraph, cfg: ConfigPairs):
+        self.graph = graph
+        if graph.input_shape is None:
+            raise ValueError("input_shape must be set")
+        cdt = global_param(cfg, "compute_dtype", "float32")
+        self.compute_dtype = {"float32": jnp.float32,
+                              "bfloat16": jnp.bfloat16,
+                              "bf16": jnp.bfloat16}[cdt]
+        # build layer objects; shared specs reuse the primary object
+        self.layers: List[Layer] = []
+        for spec in graph.layers:
+            if spec.is_shared:
+                self.layers.append(self.layers[spec.primary_layer_index])
+            else:
+                self.layers.append(create_layer(spec, graph.defcfg))
+        # shape inference over the DAG (reference InitNet/InitConnection)
+        self.node_shapes: List[Optional[Shape3]] = [None] * graph.num_nodes
+        self.node_shapes[0] = graph.input_shape
+        for i in range(graph.extra_data_num):
+            self.node_shapes[1 + i] = graph.extra_shapes[i]
+        self.layer_out_shapes: List[List[Shape3]] = []
+        for li, (spec, layer) in enumerate(zip(graph.layers, self.layers)):
+            in_shapes = []
+            for ni in spec.nindex_in:
+                if self.node_shapes[ni] is None:
+                    raise ValueError(
+                        f"layer {spec.name!r}: input node "
+                        f"{graph.node_names[ni]!r} has no value yet")
+                in_shapes.append(self.node_shapes[ni])
+            out_shapes = layer.infer_shapes(in_shapes)
+            self.layer_out_shapes.append(out_shapes)
+            for ni, s in zip(spec.nindex_out, out_shapes):
+                self.node_shapes[ni] = s
+        self.loss_layers = [(li, l) for li, l in enumerate(self.layers)
+                            if l.is_loss]
+        self._in_shapes_of = [
+            [self.node_shapes[ni] for ni in spec.nindex_in]
+            for spec in graph.layers]
+
+    # -- init --------------------------------------------------------------
+    def init(self, key: jax.Array) -> Tuple[Params, NetState]:
+        """Initialize params + state (reference NeuralNet::InitModel,
+        neural_net-inl.hpp:68-86; per-layer RNG keys replace the per-device
+        seeded mshadow::Random)."""
+        params: Params = {}
+        state: NetState = {}
+        for li, (spec, layer) in enumerate(zip(self.graph.layers, self.layers)):
+            if spec.is_shared:
+                continue
+            in_shapes = self._in_shapes_of[li]
+            if layer.has_params:
+                params[layer.name] = layer.init_params(
+                    jax.random.fold_in(key, li), in_shapes)
+            st = layer.init_state(in_shapes)
+            if st:
+                state[layer.name] = st
+        return params, state
+
+    # -- forward -----------------------------------------------------------
+    def apply(self,
+              params: Params,
+              state: NetState,
+              data: jax.Array,
+              label: Optional[jax.Array] = None,
+              mask: Optional[jax.Array] = None,
+              extra_data: Tuple[jax.Array, ...] = (),
+              rng: Optional[jax.Array] = None,
+              train: bool = False,
+              capture_nodes: bool = False) -> ForwardResult:
+        """One forward pass. ``data`` is NHWC (batch, y, x, c) or flat
+        (batch,1,1,n); ``label`` is (batch, label_width); ``mask`` is (batch,)
+        marking real rows (None = all real)."""
+        g = self.graph
+        batch = data.shape[0]
+        nodes: List[Optional[jax.Array]] = [None] * g.num_nodes
+        nodes[0] = data
+        for i, ed in enumerate(extra_data):
+            nodes[1 + i] = ed
+        if mask is None:
+            mask = jnp.ones((batch,), jnp.float32)
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        new_state: NetState = dict(state)
+        total_loss = jnp.zeros((), jnp.float32)
+        for li, (spec, layer) in enumerate(zip(g.layers, self.layers)):
+            ctx = ApplyCtx(train=train, rng=jax.random.fold_in(rng, li),
+                           compute_dtype=self.compute_dtype)
+            inputs = [nodes[ni] for ni in spec.nindex_in]
+            lparams = params.get(layer.name, {})
+            lstate = new_state.get(layer.name, {})
+            outputs, lstate_out = layer.apply(lparams, lstate, inputs, ctx)
+            if lstate_out:
+                new_state[layer.name] = lstate_out
+            for ni, out in zip(spec.nindex_out, outputs):
+                nodes[ni] = out
+            if layer.is_loss and label is not None:
+                a, b = g.label_slice(layer.target)
+                total_loss = total_loss + layer.loss(
+                    outputs, label[:, a:b].astype(jnp.float32), mask)
+        node_map = None
+        if capture_nodes:
+            node_map = {name: nodes[i] for i, name in enumerate(g.node_names)
+                        if nodes[i] is not None}
+        # "last node" = output of the final layer (reference ForwardTo default
+        # req = top node, nnet_impl-inl.hpp:203-216)
+        out = nodes[g.layers[-1].nindex_out[0]] if g.layers else data
+        return ForwardResult(loss=total_loss, state=new_state,
+                             nodes=node_map, out=out)
+
+    def node_value(self, result: ForwardResult, name: str) -> jax.Array:
+        """Look up a captured node by name or 'top[-k]' style index."""
+        assert result.nodes is not None, "apply(capture_nodes=True) required"
+        return result.nodes[name]
+
+    # -- introspection -----------------------------------------------------
+    def param_tag(self, layer_name: str, param_name: str) -> str:
+        """Tag used for lr/wd scoping: 'wmat' or 'bias'
+        (reference updater key encoding, updater.h:150-173)."""
+        return "bias" if param_name == "bias" else "wmat"
+
+    def out_shape(self) -> Shape3:
+        return self.node_shapes[self.graph.layers[-1].nindex_out[0]]
+
+    def input_nhwc(self, batch: int) -> Tuple[int, int, int, int]:
+        return to_nhwc(self.graph.input_shape, batch)

@@ -1,0 +1,230 @@
+"""Optimizers (updaters) with tag-scoped hyperparameters and LR schedules.
+
+Reference: /root/reference/src/updater/ — SGDUpdater (sgd_updater-inl.hpp:29-88),
+NAGUpdater (nag_updater-inl.hpp:17-74), AdamUpdater (adam_updater-inl.hpp:18-84),
+UpdaterParam schedules + tag scoping (param.h:12-136). The reference creates one
+updater object per weight tensor; here the optimizer is a pure pytree transform
+applied inside the jitted train step — hyperparameters are resolved per leaf by
+its tag ('wmat'/'bias'), schedule scalars are computed host-side per epoch and
+passed in as traced scalars so LR changes never trigger recompilation.
+
+Deviation from reference: AdamUpdater applies weight decay as ``grad -= wd*w``
+(adam_updater-inl.hpp:76, sign bug); here decay is standard ``grad += wd*w``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .config import ConfigPairs
+
+TAGS = ("wmat", "bias")
+
+
+@dataclasses.dataclass
+class UpdaterHyper:
+    """Per-tag hyperparameters (reference UpdaterParam)."""
+    tag: str = "wmat"
+    base_lr: float = 0.01
+    wd: float = 0.0
+    momentum: float = 0.9
+    lr_schedule: int = 0          # 0 const, 1 expdecay, 2 polydecay, 3 factor
+    lr_step: int = 1
+    lr_gamma: float = 0.5
+    lr_alpha: float = 0.5
+    lr_factor: float = 0.1
+    lr_minimum: float = 1e-5
+    start_epoch: int = 0
+    momentum_schedule: int = 0
+    base_momentum: float = 0.5
+    final_momentum: float = 0.9
+    saturation_epoch: int = 0
+    clip_gradient: float = 0.0
+    beta1_decay: float = 0.1      # adam: beta1 = 1 - beta1_decay
+    beta2_decay: float = 0.001
+
+    def set_param(self, name: str, val: str) -> None:
+        # tag scoping: "wmat:lr" applies only when tag == "wmat" (param.h:113-117)
+        if name.startswith(self.tag + ":"):
+            name = name[len(self.tag) + 1:]
+        elif ":" in name and name.split(":", 1)[0] in TAGS:
+            return  # scoped to a different tag
+        if name in ("lr", "eta"):
+            self.base_lr = float(val)
+        elif name == "wd":
+            self.wd = float(val)
+        elif name == "momentum":
+            self.momentum = float(val)
+        elif name == "momentum_schedule":
+            self.momentum_schedule = int(val)
+        elif name == "clip_gradient":
+            self.clip_gradient = float(val)
+        elif name == "final_momentum":
+            self.final_momentum = float(val)
+        elif name == "base_momentum":
+            self.base_momentum = float(val)
+        elif name == "saturation_epoch":
+            self.saturation_epoch = int(val)
+        elif name == "beta1":
+            self.beta1_decay = float(val)
+        elif name == "beta2":
+            self.beta2_decay = float(val)
+        elif name.startswith("lr:") or name.startswith("eta:"):
+            sub = name.split(":", 1)[1]
+            if sub == "schedule":
+                mapping = {"constant": 0, "expdecay": 1, "polydecay": 2,
+                           "factor": 3}
+                if val in mapping:
+                    self.lr_schedule = mapping[val]
+            elif sub == "gamma":
+                self.lr_gamma = float(val)
+            elif sub == "alpha":
+                self.lr_alpha = float(val)
+            elif sub == "step":
+                self.lr_step = int(val)
+            elif sub == "factor":
+                self.lr_factor = float(val)
+            elif sub == "minimum_lr":
+                self.lr_minimum = float(val)
+            elif sub == "start_epoch":
+                self.start_epoch = int(val)
+
+    def schedule(self, epoch: int) -> Tuple[float, float]:
+        """(learning_rate, momentum) at update-step ``epoch``
+        (reference ScheduleEpoch, param.h:78-98)."""
+        if self.lr_schedule == 0:
+            lr = self.base_lr
+        elif self.lr_schedule == 1:
+            lr = self.base_lr * (self.lr_gamma ** (epoch / self.lr_step))
+        elif self.lr_schedule == 2:
+            lr = self.base_lr * (1.0 + (epoch // self.lr_step) * self.lr_gamma) \
+                ** (-self.lr_alpha)
+        elif self.lr_schedule == 3:
+            lr = self.base_lr * (self.lr_factor ** (epoch // self.lr_step))
+        else:
+            raise ValueError("unknown lr schedule")
+        momentum = self.momentum
+        if self.momentum_schedule and self.saturation_epoch:
+            momentum = (self.final_momentum - self.base_momentum) \
+                / self.saturation_epoch * epoch + self.base_momentum
+        momentum = min(momentum, self.final_momentum) \
+            if self.momentum_schedule else momentum
+        lr = max(lr, self.lr_minimum)
+        if epoch < self.start_epoch:
+            lr = self.base_lr
+        return lr, momentum
+
+
+def build_hypers(cfg: ConfigPairs) -> Dict[str, UpdaterHyper]:
+    hypers = {tag: UpdaterHyper(tag=tag) for tag in TAGS}
+    for name, val in cfg:
+        for h in hypers.values():
+            h.set_param(name, val)
+    return hypers
+
+
+def _prep_grad(g, w, hyper: UpdaterHyper):
+    """NaN-zeroing clip (reference struct clip, sgd_updater-inl.hpp:17-25)."""
+    g = jnp.where(jnp.isnan(g), 0.0, g)
+    if hyper.clip_gradient != 0.0:
+        g = jnp.clip(g, -hyper.clip_gradient, hyper.clip_gradient)
+    if hyper.wd != 0.0:
+        g = g + hyper.wd * w
+    return g
+
+
+def _map_leaves(fn, n_out: int, *trees):
+    """Map ``fn(leaf_key, *leaves) -> n_out values`` over parallel nested
+    dicts, returning n_out trees with the shared structure."""
+    outs = tuple({} for _ in range(n_out))
+    first = trees[0]
+    for k, v in first.items():
+        if isinstance(v, dict):
+            subs = _map_leaves(fn, n_out, *(t[k] for t in trees))
+            for o, s in zip(outs, subs):
+                o[k] = s
+        else:
+            res = fn(k, *(t[k] for t in trees))
+            if n_out == 1:
+                res = (res,)
+            for o, r in zip(outs, res):
+                o[k] = r
+    return outs if n_out > 1 else outs[0]
+
+
+class Optimizer:
+    """Pure pytree optimizer dispatching per-leaf by tag; the leaf's dict key
+    ('wmat'/'bias') selects the hyperparameter group."""
+
+    def __init__(self, updater_type: str, cfg: ConfigPairs):
+        self.type = updater_type
+        if updater_type not in ("sgd", "nag", "adam"):
+            raise ValueError(f"unknown updater {updater_type!r}")
+        self.hypers = build_hypers(cfg)
+
+    # -- state -------------------------------------------------------------
+    def init_state(self, params) -> Dict[str, Any]:
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        if self.type == "adam":
+            return {"m1": zeros,
+                    "m2": jax.tree_util.tree_map(jnp.zeros_like, params),
+                    "t": jnp.zeros((), jnp.int32)}
+        return {"mom": zeros}
+
+    def _tag(self, param_name: str) -> str:
+        return "bias" if param_name == "bias" else "wmat"
+
+    def schedules(self, epoch: int) -> Dict[str, Tuple[float, float]]:
+        """Host-side schedule evaluation; pass the result into update()."""
+        return {tag: h.schedule(epoch) for tag, h in self.hypers.items()}
+
+    # -- update ------------------------------------------------------------
+    def update(self, params, grads, opt_state, sched: Dict[str, Any]):
+        """Apply one optimizer step. ``sched[tag] = (lr, momentum)`` may be
+        python floats or traced scalars. Params may be nested dicts of any
+        depth (e.g. pairtest layers hold {'master': {...}, 'slave': {...}});
+        the leaf's dict key determines its tag."""
+        if self.type == "adam":
+            t = opt_state["t"] + 1
+
+            def leaf(key, w, g, m1, m2):
+                h = self.hypers[self._tag(key)]
+                g = _prep_grad(g, w, h)
+                d1, d2 = h.beta1_decay, h.beta2_decay
+                tf = t.astype(jnp.float32)
+                fix1 = 1.0 - (1.0 - d1) ** tf
+                fix2 = 1.0 - (1.0 - d2) ** tf
+                lr, _ = sched[self._tag(key)]
+                lr_t = lr * jnp.sqrt(fix2) / fix1
+                n_m1 = m1 + d1 * (g - m1)
+                n_m2 = m2 + d2 * (jnp.square(g) - m2)
+                return w - lr_t * n_m1 / (jnp.sqrt(n_m2) + 1e-8), n_m1, n_m2
+
+            new_params, new_m1, new_m2 = _map_leaves(
+                leaf, 3, params, grads, opt_state["m1"], opt_state["m2"])
+            return new_params, {"m1": new_m1, "m2": new_m2, "t": t}
+
+        # sgd / nag
+        def leaf(key, w, g, mom):
+            h = self.hypers[self._tag(key)]
+            lr, momentum = sched[self._tag(key)]
+            g = _prep_grad(g, w, h)
+            new_m = momentum * mom - lr * g
+            if self.type == "sgd":
+                new_w = w + new_m
+            else:  # nag (nag_updater-inl.hpp:66-73)
+                new_w = w + (1 + momentum) * new_m - momentum * mom
+            return new_w, new_m
+
+        new_params, new_mom = _map_leaves(leaf, 2, params, grads,
+                                          opt_state["mom"])
+        return new_params, {"mom": new_mom}
+
+
+def create_optimizer(updater_type: str, cfg: ConfigPairs) -> Optimizer:
+    return Optimizer(updater_type, cfg)

@@ -1,0 +1,3 @@
+from .mesh import MeshContext, make_mesh_context, parse_device_spec
+
+__all__ = ["MeshContext", "make_mesh_context", "parse_device_spec"]

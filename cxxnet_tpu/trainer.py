@@ -1,0 +1,316 @@
+"""Trainer: the INetTrainer-equivalent orchestrator.
+
+Reference: INetTrainer (nnet.h:18-92) implemented by CXXNetThreadTrainer
+(nnet_impl-inl.hpp:22-488), which splits batches over per-GPU worker threads
+and syncs gradients through mshadow-ps. Here a single jitted train step over a
+device mesh replaces the whole thread/PS machinery: the batch is sharded over
+the mesh's 'data' axis, params are replicated, and XLA inserts the gradient
+all-reduce over ICI (the reference's per-layer Push/PullReq with priorities
+becomes XLA's latency-hiding schedule). ``update_period`` gradient
+accumulation (nnet_impl-inl.hpp:166-167) is implemented with a grad
+accumulator pytree and a trace-time branch. Because batch stats reduce over
+the sharded batch axis inside jit, batch_norm is effectively synchronized
+across devices (sync-BN) — a deliberate improvement over the reference's
+per-GPU stats (SURVEY §7 risks).
+
+API surface mirrors the reference trainer: init_model, save/load_model,
+start_round, update, evaluate, predict, extract_feature, copy_model_from,
+set/get_weight.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import ConfigPairs
+from .graph import build_graph, global_param
+from .metrics import MetricSet
+from .model import Network
+from .optim import create_optimizer
+from .parallel import MeshContext, make_mesh_context
+from .io.data import DataBatch
+from . import checkpoint as ckpt
+
+_METRIC_RE = re.compile(r"^metric(?:\[([^,\]]+)(?:,([^\]]+))?\])?$")
+_TOP = "!top"
+
+
+class Trainer:
+    def __init__(self, cfg: ConfigPairs, mesh_ctx: Optional[MeshContext] = None):
+        self.cfg = list(cfg)
+        self.graph = build_graph(cfg)
+        self.net = Network(self.graph, cfg)
+        gp = lambda n, d: global_param(cfg, n, d)
+        self.batch_size = int(gp("batch_size", "128"))
+        self.update_period = int(gp("update_period", "1"))
+        self.eval_train = int(gp("eval_train", "1"))
+        self.seed = int(gp("seed", "0"))
+        self.silent = int(gp("silent", "0"))
+        dev = gp("dev", "")
+        model_parallel = int(gp("model_parallel", "1"))
+        self.mesh = mesh_ctx or make_mesh_context(dev or "tpu",
+                                                  model_parallel=model_parallel)
+        self.optimizer = create_optimizer(self.graph.updater_type, cfg)
+        # metric bindings (reference nnet_impl-inl.hpp:73-83)
+        self.metric = MetricSet()
+        self.train_metric = MetricSet()
+        self._metric_nodes: List[Optional[str]] = []
+        for name, val in cfg:
+            m = _METRIC_RE.match(name)
+            if not m:
+                continue
+            label_field, node = m.group(1), m.group(2)
+            if label_field is None or node is None:
+                self.metric.add(val, "label", None)
+                self.train_metric.add(val, "label", None)
+                self._metric_nodes.append(None)
+            else:
+                self.metric.add(val, label_field, node)
+                self.train_metric.add(val, label_field, node)
+                self._metric_nodes.append(node)
+        # counters (reference epoch_counter = #updates; round = epoch)
+        self.epoch_counter = 0
+        self.sample_counter = 0
+        self.round_counter = 0
+        self.params = None
+        self.net_state = None
+        self.opt_state = None
+        self.accum = None
+        self._base_key = jax.random.PRNGKey(self.seed)
+        self._step_count = 0
+        self._train_step_fns: Dict[bool, Any] = {}
+        self._eval_step_fn = None
+        self._last_loss = None
+        if self.batch_size % self.mesh.data_parallel:
+            raise ValueError(
+                f"batch_size {self.batch_size} not divisible by data-parallel "
+                f"degree {self.mesh.data_parallel}")
+
+    # -- model lifecycle ---------------------------------------------------
+    def init_model(self) -> None:
+        params, net_state = self.net.init(self._base_key)
+        self.params = self.mesh.replicate(params)
+        self.net_state = self.mesh.replicate(net_state)
+        self.opt_state = self.mesh.replicate(self.optimizer.init_state(params))
+        if self.update_period > 1:
+            self.accum = self.mesh.replicate(
+                jax.tree_util.tree_map(jnp.zeros_like, params))
+
+    def save_model(self, path: str) -> None:
+        ckpt.save_model(
+            path, structure_sig=self.graph.structure_signature(),
+            round_counter=self.round_counter, epoch_counter=self.epoch_counter,
+            params=self.params, net_state=self.net_state,
+            opt_state=self.opt_state)
+
+    def load_model(self, path: str) -> None:
+        blob = ckpt.load_model(path)
+        ckpt.check_structure(blob["meta"], self.graph.structure_signature())
+        self.params = self.mesh.replicate(blob["params"])
+        self.net_state = self.mesh.replicate(blob["state"])
+        if blob["opt"] is not None:
+            self.opt_state = self.mesh.replicate(blob["opt"])
+        else:
+            self.opt_state = self.mesh.replicate(
+                self.optimizer.init_state(blob["params"]))
+        if self.update_period > 1:
+            self.accum = self.mesh.replicate(
+                jax.tree_util.tree_map(jnp.zeros_like, blob["params"]))
+        self.round_counter = blob["meta"]["round"]
+        self.epoch_counter = blob["meta"]["epoch"]
+
+    def copy_model_from(self, path: str) -> None:
+        """Finetune restore: name-matched layer copy from another model."""
+        blob = ckpt.load_model(path)
+        fresh = ckpt.jax_to_numpy(self.params)
+        merged = ckpt.copy_model_from(fresh, blob["params"],
+                                      verbose=not self.silent)
+        self.params = self.mesh.replicate(merged)
+
+    def start_round(self, round_counter: int) -> None:
+        self.round_counter = round_counter
+
+    # -- weights API (reference SetWeight/GetWeight, nnet.h:69-91) ---------
+    def get_weight(self, layer_name: str, tag: str) -> np.ndarray:
+        return np.asarray(self.params[layer_name][tag])
+
+    def set_weight(self, weight: np.ndarray, layer_name: str, tag: str) -> None:
+        cur = self.params[layer_name][tag]
+        if tuple(weight.shape) != tuple(cur.shape):
+            raise ValueError(
+                f"set_weight: shape {weight.shape} != {tuple(cur.shape)}")
+        p = ckpt.jax_to_numpy(self.params)
+        p[layer_name][tag] = np.asarray(weight, dtype=np.asarray(cur).dtype)
+        self.params = self.mesh.replicate(p)
+
+    # -- train step --------------------------------------------------------
+    def _needed_nodes(self) -> List[str]:
+        return sorted({n for n in self._metric_nodes if n is not None})
+
+    def _make_train_step(self, do_update: bool):
+        net, opt, period = self.net, self.optimizer, self.update_period
+        needed = self._needed_nodes()
+        capture = bool(needed)
+
+        def step(params, opt_state, net_state, accum, data, label, mask,
+                 extra, rng, sched):
+            def loss_fn(p):
+                res = net.apply(p, net_state, data, label, mask,
+                                extra_data=extra, rng=rng, train=True,
+                                capture_nodes=capture)
+                nodes = {_TOP: res.out}
+                if capture:
+                    nodes.update({n: res.nodes[n] for n in needed})
+                return res.loss, (res.state, nodes)
+            (loss, (new_state, nodes)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            if period > 1:
+                accum = jax.tree_util.tree_map(jnp.add, accum, grads)
+                if do_update:
+                    scaled = jax.tree_util.tree_map(
+                        lambda g: g / period, accum)
+                    params, opt_state = opt.update(params, scaled, opt_state,
+                                                   sched)
+                    accum = jax.tree_util.tree_map(jnp.zeros_like, accum)
+            else:
+                params, opt_state = opt.update(params, grads, opt_state, sched)
+            return params, opt_state, new_state, accum, loss, nodes
+
+        return jax.jit(step, donate_argnums=(0, 1, 2, 3))
+
+    def _sched_scalars(self):
+        sched = self.optimizer.schedules(self.epoch_counter)
+        return {tag: (jnp.float32(lr), jnp.float32(mom))
+                for tag, (lr, mom) in sched.items()}
+
+    def update(self, batch: DataBatch) -> None:
+        """One minibatch forward/backward(+update) — reference Update
+        (nnet_impl-inl.hpp:157-202)."""
+        assert self.params is not None, "call init_model() first"
+        do_update = (self.sample_counter + 1) % self.update_period == 0 \
+            if self.update_period > 1 else True
+        key = do_update
+        if key not in self._train_step_fns:
+            self._train_step_fns[key] = self._make_train_step(do_update)
+        step = self._train_step_fns[key]
+        data, label = self.mesh.shard_batch(batch.data, batch.label)
+        mask = self._mask(batch)
+        extra = tuple(self.mesh.shard_batch(e) for e in batch.extra_data)
+        rng = jax.random.fold_in(self._base_key, self._step_count)
+        accum_in = self.accum if self.update_period > 1 else {}
+        (self.params, self.opt_state, self.net_state, accum, loss,
+         nodes) = step(self.params, self.opt_state, self.net_state, accum_in,
+                       data, label, mask, extra, rng, self._sched_scalars())
+        if self.update_period > 1:
+            self.accum = accum
+        self._last_loss = loss
+        self._step_count += 1
+        self.sample_counter += 1
+        if self.sample_counter >= self.update_period:
+            self.sample_counter = 0
+            self.epoch_counter += 1
+        if self.eval_train:
+            self._add_metric(self.train_metric, nodes, batch)
+
+    def _mask(self, batch: DataBatch):
+        mask = np.ones((batch.batch_size,), np.float32)
+        if batch.num_batch_padd:
+            mask[batch.batch_size - batch.num_batch_padd:] = 0.0
+        return self.mesh.shard_batch(mask)
+
+    def _add_metric(self, mset: MetricSet, nodes: Dict[str, jax.Array],
+                    batch: DataBatch) -> None:
+        n_real = batch.batch_size - batch.num_batch_padd
+        if n_real <= 0:
+            return
+        node_vals = {}
+        for key, arr in nodes.items():
+            a = np.asarray(arr)
+            node_vals[None if key == _TOP else key] = \
+                a.reshape(a.shape[0], -1)[:n_real]
+        slices = {name: self.graph.label_slice(name)
+                  for name in self.graph.label_name_map}
+        mset.add_eval(node_vals, np.asarray(batch.label)[:n_real], slices)
+
+    # -- evaluation / inference -------------------------------------------
+    def _make_eval_step(self, extract: Tuple[str, ...] = ()):
+        net = self.net
+        needed = sorted(set(self._needed_nodes()) | set(extract))
+        capture = bool(needed)
+
+        def step(params, net_state, data, extra):
+            res = net.apply(params, net_state, data, extra_data=extra,
+                            train=False, capture_nodes=capture)
+            nodes = {_TOP: res.out}
+            if capture:
+                nodes.update({n: res.nodes[n] for n in needed})
+            return nodes
+
+        return jax.jit(step)
+
+    def _eval_nodes(self, batch: DataBatch,
+                    extract: Tuple[str, ...] = ()) -> Dict[str, jax.Array]:
+        key = tuple(extract)
+        if self._eval_step_fn is None or self._eval_step_fn[0] != key:
+            self._eval_step_fn = (key, self._make_eval_step(extract))
+        data = self.mesh.shard_batch(batch.data)
+        extra = tuple(self.mesh.shard_batch(e) for e in batch.extra_data)
+        return self._eval_step_fn[1](self.params, self.net_state, data, extra)
+
+    def evaluate(self, data_iter, name: str) -> str:
+        """Run all metrics over an iterator; returns the reference's round
+        log fragment ``\\tname-metric:value`` (nnet_impl-inl.hpp:241-276)."""
+        self.metric.clear()
+        for batch in data_iter:
+            nodes = self._eval_nodes(batch)
+            self._add_metric(self.metric, nodes, batch)
+        out = ""
+        for mname, val in self.metric.get(name):
+            out += "\t%s:%f" % (mname, val)
+        return out
+
+    def train_metric_report(self, name: str = "train") -> str:
+        out = ""
+        for mname, val in self.train_metric.get(name):
+            out += "\t%s:%f" % (mname, val)
+        self.train_metric.clear()
+        return out
+
+    def predict(self, batch: DataBatch) -> np.ndarray:
+        """Class predictions (argmax of top node; raw value when the top node
+        has one column) — reference Predict + TransformPred
+        (nnet_impl-inl.hpp:203-216,317-330)."""
+        nodes = self._eval_nodes(batch)
+        out = np.asarray(nodes[_TOP])
+        out2d = out.reshape(out.shape[0], -1)
+        n_real = batch.batch_size - batch.num_batch_padd
+        if out2d.shape[1] != 1:
+            return np.argmax(out2d[:n_real], axis=1).astype(np.float32)
+        return out2d[:n_real, 0]
+
+    def predict_raw(self, batch: DataBatch) -> np.ndarray:
+        nodes = self._eval_nodes(batch)
+        out = np.asarray(nodes[_TOP])
+        n_real = batch.batch_size - batch.num_batch_padd
+        return out.reshape(out.shape[0], -1)[:n_real]
+
+    def extract_feature(self, batch: DataBatch, node_name: str) -> np.ndarray:
+        """Extract an intermediate node's value by name (reference
+        ExtractFeature, nnet_impl-inl.hpp; 'top' = last node)."""
+        if node_name in ("top", "top[-1]"):
+            nodes = self._eval_nodes(batch)
+            arr = np.asarray(nodes[_TOP])
+        else:
+            nodes = self._eval_nodes(batch, extract=(node_name,))
+            arr = np.asarray(nodes[node_name])
+        n_real = batch.batch_size - batch.num_batch_padd
+        return arr.reshape(arr.shape[0], -1)[:n_real]
+
+    @property
+    def last_loss(self) -> float:
+        return float(self._last_loss) if self._last_loss is not None else float("nan")

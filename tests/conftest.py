@@ -1,0 +1,38 @@
+"""Test config: run everything on a virtual 8-device CPU mesh.
+
+This is the TPU analog of the reference's ps-lite local mode / dev=cpu
+fallback (SURVEY §4): multi-device semantics are exercised without hardware
+via XLA's forced host platform device count.
+"""
+
+import os
+
+# The session image imports jax at interpreter startup (axon sitecustomize),
+# so env vars alone are too late here — use jax.config to (a) force the CPU
+# backend and (b) fake 8 devices. Unit tests always run on the virtual CPU
+# mesh regardless of attached hardware.
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from cxxnet_tpu.parallel import make_mesh_context
+    assert len(jax.devices()) == 8
+    return make_mesh_context(devices=jax.devices())
+
+
+@pytest.fixture(scope="session")
+def mesh1():
+    from cxxnet_tpu.parallel import make_mesh_context
+    return make_mesh_context(devices=jax.devices()[:1])

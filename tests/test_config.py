@@ -1,0 +1,75 @@
+import pytest
+
+from cxxnet_tpu.config import (ConfigError, parse_cli_overrides,
+                               parse_config_string)
+
+
+def test_basic_pairs():
+    cfg = parse_config_string("a = 1\nb=2\n  c   =   hello\n")
+    assert cfg == [("a", "1"), ("b", "2"), ("c", "hello")]
+
+
+def test_comments_and_blank_lines():
+    cfg = parse_config_string("# full line comment\na = 1 # trailing\n\nb = 2\n")
+    assert cfg == [("a", "1"), ("b", "2")]
+
+
+def test_quoted_strings():
+    cfg = parse_config_string('path = "./data/my file.bin"\n')
+    assert cfg == [("path", "./data/my file.bin")]
+
+
+def test_multiline_single_quote():
+    cfg = parse_config_string("doc = 'line1\nline2'\n")
+    assert cfg == [("doc", "line1\nline2")]
+
+
+def test_escaped_quote():
+    cfg = parse_config_string('x = "a\\"b"\n')
+    assert cfg == [("x", 'a"b')]
+
+
+def test_layer_syntax_tokens():
+    cfg = parse_config_string("layer[+1:fc1] = fullc:fc1\n  nhidden = 100\n")
+    assert cfg == [("layer[+1:fc1]", "fullc:fc1"), ("nhidden", "100")]
+
+
+def test_unterminated_string_raises():
+    with pytest.raises(ConfigError):
+        parse_config_string('x = "abc\n')
+
+
+def test_missing_value_raises():
+    with pytest.raises(ConfigError):
+        parse_config_string("x =\ny = 2")
+
+
+def test_cli_overrides():
+    assert parse_cli_overrides(["a=1", "b=foo=bar"]) == \
+        [("a", "1"), ("b", "foo=bar")]
+    with pytest.raises(ConfigError):
+        parse_cli_overrides(["noequals"])
+
+
+def test_reference_mnist_conf_parses():
+    # the exact dialect of example/MNIST/MNIST.conf
+    text = """
+data = train
+iter = mnist
+    path_img = "./data/train-images-idx3-ubyte"
+    shuffle = 1
+iter = end
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 100
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,784
+batch_size = 100
+eta = 0.1
+metric[label] = error
+"""
+    cfg = parse_config_string(text)
+    names = [k for k, _ in cfg]
+    assert "layer[+1:fc1]" in names
+    assert ("metric[label]", "error") == cfg[-1]

@@ -1,0 +1,154 @@
+import pytest
+
+from cxxnet_tpu.config import ConfigError, parse_config_string
+from cxxnet_tpu.graph import build_graph
+
+MLP = """
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 100
+  init_sigma = 0.01
+layer[+1:sg1] = sigmoid:se1
+layer[sg1->fc2] = fullc:fc2
+  nhidden = 10
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,784
+batch_size = 100
+eta = 0.1
+"""
+
+
+def test_mlp_graph():
+    g = build_graph(parse_config_string(MLP))
+    assert g.input_shape == (1, 1, 784)
+    assert [l.type for l in g.layers] == ["fullc", "sigmoid", "fullc", "softmax"]
+    # node wiring: in(0) -> fc1(1) -> sg1(2) -> fc2(3); softmax self-loop on 3
+    assert g.layers[0].nindex_in == [0] and g.layers[0].nindex_out == [1]
+    assert g.layers[1].nindex_in == [1] and g.layers[1].nindex_out == [2]
+    assert g.layers[2].nindex_in == [2] and g.layers[2].nindex_out == [3]
+    assert g.layers[3].nindex_in == [3] and g.layers[3].nindex_out == [3]
+    # layer params attach to the correct layer
+    assert ("nhidden", "100") in g.layers[0].cfg
+    assert ("init_sigma", "0.01") in g.layers[0].cfg
+    assert ("nhidden", "10") in g.layers[2].cfg
+    # globals land in defcfg
+    assert ("eta", "0.1") in g.defcfg
+    assert g.layers[0].name == "fc1"
+    assert g.layer_name_map["fc2"] == 2
+
+
+def test_explicit_node_indices():
+    text = """
+netconfig=start
+layer[0->1] = conv:cv1
+  kernel_size = 3
+  nchannel = 8
+layer[1->2] = max_pooling
+  kernel_size = 2
+layer[2->2] = dropout
+netconfig=end
+input_shape = 3,28,28
+"""
+    g = build_graph(parse_config_string(text))
+    assert g.layers[2].nindex_in == g.layers[2].nindex_out == [2]
+
+
+def test_multi_input_concat():
+    text = """
+netconfig=start
+layer[0->a] = fullc:f1
+  nhidden = 4
+layer[0->b] = fullc:f2
+  nhidden = 4
+layer[a,b->c] = concat
+netconfig=end
+input_shape = 1,1,8
+"""
+    g = build_graph(parse_config_string(text))
+    concat = g.layers[2]
+    assert len(concat.nindex_in) == 2
+    assert g.node_names[concat.nindex_out[0]] == "c"
+
+
+def test_shared_layer():
+    text = """
+netconfig=start
+layer[+1:h1] = fullc:fc1
+  nhidden = 8
+layer[+1:h2] = share[fc1]
+netconfig=end
+input_shape = 1,1,8
+"""
+    g = build_graph(parse_config_string(text))
+    assert g.layers[1].is_shared
+    assert g.layers[1].primary_layer_index == 0
+
+
+def test_shared_layer_param_rejected():
+    text = """
+netconfig=start
+layer[+1:h1] = fullc:fc1
+  nhidden = 8
+layer[+1:h2] = share[fc1]
+  nhidden = 16
+netconfig=end
+"""
+    with pytest.raises(ConfigError):
+        build_graph(parse_config_string(text))
+
+
+def test_label_vec():
+    text = """
+label_vec[0,1) = cls
+label_vec[1,4) = coords
+netconfig=start
+layer[+1:f] = fullc:f
+  nhidden = 4
+netconfig=end
+input_shape = 1,1,4
+"""
+    g = build_graph(parse_config_string(text))
+    assert g.label_slice("cls") == (0, 1)
+    assert g.label_slice("coords") == (1, 4)
+    assert g.label_width() == 4
+
+
+def test_undefined_input_node_rejected():
+    text = """
+netconfig=start
+layer[bogus->out] = fullc:f
+  nhidden = 4
+netconfig=end
+"""
+    with pytest.raises(ConfigError):
+        build_graph(parse_config_string(text))
+
+
+def test_pairtest_parse():
+    text = """
+netconfig=start
+layer[+1] = pairtest-relu-sigmoid
+netconfig=end
+input_shape = 1,1,4
+"""
+    g = build_graph(parse_config_string(text))
+    assert g.layers[0].type == "pairtest"
+    assert g.layers[0].pairtest == ("relu", "sigmoid")
+
+
+def test_extra_data():
+    text = """
+extra_data_num = 2
+extra_data_shape[0] = 1,1,10
+extra_data_shape[1] = 1,1,20
+netconfig=start
+layer[in_1->h] = fullc:f
+  nhidden = 4
+netconfig=end
+input_shape = 1,1,4
+"""
+    g = build_graph(parse_config_string(text))
+    assert g.extra_data_num == 2
+    assert g.node_name_map["in_1"] == 1
+    assert g.layers[0].nindex_in == [1]

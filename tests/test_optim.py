@@ -1,0 +1,104 @@
+import jax.numpy as jnp
+import numpy as np
+
+from cxxnet_tpu.optim import UpdaterHyper, build_hypers, create_optimizer
+
+
+def _params(w):
+    return {"l1": {"wmat": jnp.asarray(w)}}
+
+
+def test_sgd_momentum_math():
+    opt = create_optimizer("sgd", [("eta", "0.1"), ("momentum", "0.9"),
+                                   ("wd", "0.01")])
+    w = np.array([1.0, -2.0], np.float32)
+    g = np.array([0.5, 0.5], np.float32)
+    params = _params(w)
+    st = opt.init_state(params)
+    sched = opt.schedules(0)
+    p1, st1 = opt.update(params, _params(g), st, sched)
+    m1 = -0.1 * (g + 0.01 * w)
+    np.testing.assert_allclose(np.asarray(p1["l1"]["wmat"]), w + m1, rtol=1e-6)
+    p2, st2 = opt.update(p1, _params(g), st1, sched)
+    w1 = w + m1
+    m2 = 0.9 * m1 - 0.1 * (g + 0.01 * w1)
+    np.testing.assert_allclose(np.asarray(p2["l1"]["wmat"]), w1 + m2, rtol=1e-6)
+
+
+def test_nag_math():
+    opt = create_optimizer("nag", [("eta", "0.1"), ("momentum", "0.9")])
+    w = np.array([1.0], np.float32)
+    g = np.array([1.0], np.float32)
+    params = _params(w)
+    st = opt.init_state(params)
+    p1, _ = opt.update(params, _params(g), st, opt.schedules(0))
+    # m = -0.1; w + (1.9)*m - 0.9*0 = 1 - 0.19
+    np.testing.assert_allclose(np.asarray(p1["l1"]["wmat"]), [0.81], rtol=1e-6)
+
+
+def test_adam_first_step():
+    opt = create_optimizer("adam", [("eta", "0.002")])
+    w = np.array([1.0], np.float32)
+    g = np.array([3.0], np.float32)
+    params = _params(w)
+    st = opt.init_state(params)
+    p1, st1 = opt.update(params, _params(g), st, opt.schedules(0))
+    # t=1: fix1=d1=0.1, fix2=d2=0.001; lr_t = lr*sqrt(.001)/.1
+    # m1 = 0.1*g, m2 = 0.001*g^2 -> update = lr_t*m1/(sqrt(m2)+eps) ~ lr
+    lr_t = 0.002 * np.sqrt(0.001) / 0.1
+    upd = lr_t * 0.3 / (np.sqrt(0.009) + 1e-8)
+    np.testing.assert_allclose(np.asarray(p1["l1"]["wmat"]), w - upd, rtol=1e-5)
+    assert int(st1["t"]) == 1
+
+
+def test_nan_grad_zeroed_and_clip():
+    opt = create_optimizer("sgd", [("eta", "1.0"), ("momentum", "0.0"),
+                                   ("clip_gradient", "0.5")])
+    w = np.array([1.0, 1.0, 1.0], np.float32)
+    g = np.array([np.nan, 10.0, -10.0], np.float32)
+    p1, _ = opt.update(_params(w), _params(g), opt.init_state(_params(w)),
+                       opt.schedules(0))
+    np.testing.assert_allclose(np.asarray(p1["l1"]["wmat"]), [1.0, 0.5, 1.5],
+                               rtol=1e-6)
+
+
+def test_tag_scoped_hypers():
+    cfg = [("eta", "0.1"), ("wd", "0.005"), ("bias:wd", "0.0"),
+           ("bias:eta", "0.2")]
+    hypers = build_hypers(cfg)
+    assert hypers["wmat"].base_lr == 0.1
+    assert hypers["wmat"].wd == 0.005
+    assert hypers["bias"].wd == 0.0
+    assert hypers["bias"].base_lr == 0.2
+
+
+def test_lr_schedules():
+    h = UpdaterHyper()
+    h.set_param("eta", "0.1")
+    h.set_param("lr:schedule", "expdecay")
+    h.set_param("lr:gamma", "0.5")
+    h.set_param("lr:step", "100")
+    lr, _ = h.schedule(0)
+    assert abs(lr - 0.1) < 1e-9
+    lr, _ = h.schedule(100)
+    assert abs(lr - 0.05) < 1e-9
+    h2 = UpdaterHyper()
+    h2.set_param("eta", "0.1")
+    h2.set_param("lr:schedule", "factor")
+    h2.set_param("lr:factor", "0.1")
+    h2.set_param("lr:step", "10")
+    assert abs(h2.schedule(9)[0] - 0.1) < 1e-9
+    assert abs(h2.schedule(10)[0] - 0.01) < 1e-9
+    h2.set_param("lr:minimum_lr", "0.05")
+    assert abs(h2.schedule(10)[0] - 0.05) < 1e-9
+
+
+def test_momentum_schedule():
+    h = UpdaterHyper()
+    h.set_param("momentum_schedule", "1")
+    h.set_param("base_momentum", "0.5")
+    h.set_param("final_momentum", "0.9")
+    h.set_param("saturation_epoch", "100")
+    assert abs(h.schedule(0)[1] - 0.5) < 1e-9
+    assert abs(h.schedule(50)[1] - 0.7) < 1e-9
+    assert abs(h.schedule(1000)[1] - 0.9) < 1e-9
