@@ -1,0 +1,239 @@
+"""Image augmentation pipeline (host-side, per-instance).
+
+Reference analogs:
+  * ImageAugmenter (/root/reference/src/io/image_augmenter-inl.hpp:13-224):
+    OpenCV affine pipeline — rotation (max_rotate_angle / rotate_list /
+    fixed ``rotate``), shear, aspect-ratio jitter, random scale
+    (min/max_random_scale), random/center crop to (y,x), mirror, fill_value.
+  * AugmentIterator (/root/reference/src/io/iter_augment_proc-inl.hpp:22-254):
+    crop offsets (rand vs center vs fixed crop_y_start/crop_x_start), mirror,
+    ``divideby`` scaling, mean-image subtraction with on-the-fly computation
+    and caching, mean_value RGB, max_random_contrast / max_random_illumination.
+
+Arrays are float32 HWC (RGB). cv2 is used when an affine transform is
+actually requested; the plain crop/mirror path is pure numpy so the common
+case has no cv2 dependency.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class AugmentParams:
+    """Parsed augmentation settings; names match the reference config keys."""
+
+    def __init__(self) -> None:
+        self.rand_crop = 0
+        self.rand_mirror = 0
+        self.mirror = 0
+        self.crop_y_start = -1
+        self.crop_x_start = -1
+        self.max_rotate_angle = 0.0
+        self.max_aspect_ratio = 0.0
+        self.max_shear_ratio = 0.0
+        self.min_crop_size = -1
+        self.max_crop_size = -1
+        self.min_random_scale = 1.0
+        self.max_random_scale = 1.0
+        self.min_img_size = 0.0
+        self.max_img_size = 1e10
+        self.rotate = -1
+        self.rotate_list: Sequence[int] = ()
+        self.fill_value = 255
+        self.max_random_contrast = 0.0
+        self.max_random_illumination = 0.0
+        self.mean_value: Optional[np.ndarray] = None    # (3,) RGB
+        self.mean_img: str = ""
+        self.divideby = 1.0
+        self.scale = 1.0
+
+    def set_param(self, name: str, val: str) -> bool:
+        if name == "rand_crop":
+            self.rand_crop = int(val)
+        elif name == "rand_mirror":
+            self.rand_mirror = int(val)
+        elif name == "mirror":
+            self.mirror = int(val)
+        elif name == "crop_y_start":
+            self.crop_y_start = int(val)
+        elif name == "crop_x_start":
+            self.crop_x_start = int(val)
+        elif name == "max_rotate_angle":
+            self.max_rotate_angle = float(val)
+        elif name == "max_aspect_ratio":
+            self.max_aspect_ratio = float(val)
+        elif name == "max_shear_ratio":
+            self.max_shear_ratio = float(val)
+        elif name == "min_crop_size":
+            self.min_crop_size = int(val)
+        elif name == "max_crop_size":
+            self.max_crop_size = int(val)
+        elif name == "min_random_scale":
+            self.min_random_scale = float(val)
+        elif name == "max_random_scale":
+            self.max_random_scale = float(val)
+        elif name == "min_img_size":
+            self.min_img_size = float(val)
+        elif name == "max_img_size":
+            self.max_img_size = float(val)
+        elif name == "rotate":
+            self.rotate = int(val)
+        elif name == "rotate_list":
+            self.rotate_list = [int(x) for x in val.split(",") if x]
+        elif name == "fill_value":
+            self.fill_value = int(val)
+        elif name == "max_random_contrast":
+            self.max_random_contrast = float(val)
+        elif name == "max_random_illumination":
+            self.max_random_illumination = float(val)
+        elif name == "image_mean":
+            self.mean_img = val
+        elif name == "mean_value":
+            self.mean_value = np.asarray(
+                [float(x) for x in val.split(",")], np.float32)
+        elif name == "divideby":
+            self.divideby = float(val)
+        elif name == "scale":
+            self.scale = float(val)
+        else:
+            return False
+        return True
+
+    @property
+    def needs_affine(self) -> bool:
+        return (self.max_rotate_angle > 0 or self.max_shear_ratio > 0
+                or self.rotate > 0 or len(self.rotate_list) > 0
+                or self.max_aspect_ratio > 0
+                or self.min_crop_size > 0
+                or self.min_random_scale != 1.0
+                or self.max_random_scale != 1.0)
+
+
+class ImageAugmenter:
+    """Affine + crop + photometric augmentation of one HWC float image."""
+
+    def __init__(self, p: AugmentParams, out_shape: Tuple[int, int, int]):
+        self.p = p
+        self.out_c, self.out_y, self.out_x = out_shape
+
+    def _affine(self, img: np.ndarray, rng: np.random.RandomState) -> np.ndarray:
+        import cv2
+        p = self.p
+        if p.rotate_list:
+            angle = float(p.rotate_list[rng.randint(len(p.rotate_list))])
+        elif p.rotate >= 0:
+            angle = float(p.rotate)
+        else:
+            angle = rng.uniform(-p.max_rotate_angle, p.max_rotate_angle)
+        a = angle * np.pi / 180.0
+        # aspect/shear jitter on top of rotation (image_augmenter-inl.hpp:70-150)
+        ratio = 1.0 + rng.uniform(-p.max_aspect_ratio, p.max_aspect_ratio) \
+            if p.max_aspect_ratio > 0 else 1.0
+        shear = rng.uniform(-p.max_shear_ratio, p.max_shear_ratio) \
+            if p.max_shear_ratio > 0 else 0.0
+        if p.min_crop_size > 0 and p.max_crop_size + 1 > p.min_crop_size:
+            crop = rng.randint(p.min_crop_size, p.max_crop_size + 1)
+            scale = float(self.out_y) / crop
+        else:
+            scale = rng.uniform(p.min_random_scale, p.max_random_scale)
+        hs, ws = scale * ratio, scale / max(ratio, 1e-8)
+        cos_a, sin_a = np.cos(a), np.sin(a)
+        m = np.array([[cos_a * ws, (sin_a + shear) * hs, 0.0],
+                      [-sin_a * ws, (cos_a + shear) * hs, 0.0]], np.float32)
+        h, w = img.shape[:2]
+        m[0, 2] = self.out_x / 2.0 - (m[0, 0] * w / 2.0 + m[0, 1] * h / 2.0)
+        m[1, 2] = self.out_y / 2.0 - (m[1, 0] * w / 2.0 + m[1, 1] * h / 2.0)
+        fv = float(self.p.fill_value)
+        return cv2.warpAffine(
+            img, m, (self.out_x, self.out_y), flags=cv2.INTER_LINEAR,
+            borderMode=cv2.BORDER_CONSTANT, borderValue=(fv, fv, fv))
+
+    def _crop(self, img: np.ndarray, rng: np.random.RandomState) -> np.ndarray:
+        """Random/center/fixed crop to (out_y, out_x)
+        (iter_augment_proc-inl.hpp:60-140)."""
+        h, w = img.shape[:2]
+        oy, ox = self.out_y, self.out_x
+        if h == oy and w == ox:
+            return img
+        if h < oy or w < ox:     # upscale small images to cover the crop
+            import cv2
+            s = max(oy / h, ox / w)
+            img = cv2.resize(img, (max(ox, int(w * s + 0.5)),
+                                   max(oy, int(h * s + 0.5))),
+                             interpolation=cv2.INTER_LINEAR)
+            h, w = img.shape[:2]
+        p = self.p
+        if p.rand_crop:
+            y0 = rng.randint(0, h - oy + 1)
+            x0 = rng.randint(0, w - ox + 1)
+        elif p.crop_y_start >= 0 or p.crop_x_start >= 0:
+            y0 = max(p.crop_y_start, 0)
+            x0 = max(p.crop_x_start, 0)
+        else:
+            y0, x0 = (h - oy) // 2, (w - ox) // 2
+        return img[y0:y0 + oy, x0:x0 + ox]
+
+    def process(self, img: np.ndarray,
+                rng: np.random.RandomState) -> np.ndarray:
+        """HWC uint8/float in, (out_y, out_x, C) float32 out (pre-mean)."""
+        img = np.asarray(img, np.float32)
+        if img.ndim == 2:
+            img = img[:, :, None]
+        if self.p.needs_affine:
+            img = self._affine(img, rng)
+            if img.ndim == 2:
+                img = img[:, :, None]
+        img = self._crop(img, rng)
+        if (self.p.rand_mirror and rng.randint(2)) or self.p.mirror:
+            img = img[:, ::-1]
+        p = self.p
+        if p.max_random_contrast > 0 or p.max_random_illumination > 0:
+            c = 1.0 + rng.uniform(-p.max_random_contrast,
+                                  p.max_random_contrast)
+            b = rng.uniform(-p.max_random_illumination,
+                            p.max_random_illumination)
+            img = img * c + b
+        return np.ascontiguousarray(img, np.float32)
+
+
+class MeanStore:
+    """Mean-image subtraction with on-the-fly computation + .npy caching
+    (reference CreateMeanImg, iter_augment_proc-inl.hpp:175-205; the cache
+    format here is numpy's, not mshadow's)."""
+
+    def __init__(self, path: str, shape_hwc: Tuple[int, int, int]):
+        self.path = path
+        self.shape = shape_hwc
+        self.mean: Optional[np.ndarray] = None
+        if path and os.path.exists(path):
+            self.mean = np.load(path)
+
+    @property
+    def ready(self) -> bool:
+        return self.mean is not None
+
+    def compute(self, images) -> None:
+        """images: iterable of (out_y, out_x, c) float arrays."""
+        acc = np.zeros(self.shape, np.float64)
+        n = 0
+        for im in images:
+            acc += im
+            n += 1
+        self.mean = (acc / max(n, 1)).astype(np.float32)
+        if self.path:
+            np.save(self.path, self.mean)
+
+    def apply(self, img: np.ndarray, p: AugmentParams) -> np.ndarray:
+        if p.mean_value is not None:
+            img = img - p.mean_value
+        elif self.mean is not None:
+            img = img - self.mean
+        if p.divideby != 1.0:
+            img = img * (1.0 / p.divideby)
+        if p.scale != 1.0:
+            img = img * p.scale
+        return img

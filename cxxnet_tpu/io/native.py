@@ -1,0 +1,98 @@
+"""ctypes bridge to the native data-plane library (cxxnet_tpu/native/).
+
+Loads ``libcxxnet_native.so`` if built (cxxnet_tpu/native/build.sh) and
+exposes JPEG decode; falls back silently (returning None) so the pure-
+Python pipeline keeps working without the native build. ctypes releases
+the GIL during calls, so a ThreadPoolExecutor over these decoders gets
+real multi-core parallelism — the same design as the reference's OpenMP
+decode loop (iter_image_recordio-inl.hpp:206-250).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+_lib = None
+_lib_lock = threading.Lock()
+_tried = False
+
+
+def _load():
+    global _lib, _tried
+    if _tried:
+        return _lib
+    with _lib_lock:
+        if _tried:
+            return _lib
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "native", "libcxxnet_native.so")
+        try:
+            lib = ctypes.CDLL(path)
+            lib.cxn_jpeg_dims.restype = ctypes.c_int
+            lib.cxn_jpeg_dims.argtypes = [
+                ctypes.c_char_p, ctypes.c_long,
+                ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int)]
+            lib.cxn_jpeg_decode.restype = ctypes.c_int
+            lib.cxn_jpeg_decode.argtypes = [
+                ctypes.c_char_p, ctypes.c_long, ctypes.c_int,
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
+            lib.cxn_normalize.restype = None
+            lib.cxn_normalize.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_float,
+                ctypes.c_void_p, ctypes.c_long]
+            _lib = lib
+        except OSError:
+            _lib = None
+        _tried = True
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def try_decode(data: bytes, want_channels: int = 3) -> Optional[np.ndarray]:
+    """Decode JPEG bytes to HWC uint8, or None if the native lib is absent
+    or the payload is not a JPEG it can handle."""
+    lib = _load()
+    if lib is None:
+        return None
+    h = ctypes.c_int()
+    w = ctypes.c_int()
+    c = ctypes.c_int()
+    if lib.cxn_jpeg_dims(data, len(data), ctypes.byref(h), ctypes.byref(w),
+                         ctypes.byref(c)) != 0:
+        return None
+    out = np.empty((h.value, w.value, want_channels), np.uint8)
+    rc = lib.cxn_jpeg_decode(data, len(data), want_channels,
+                             out.ctypes.data_as(ctypes.c_void_p),
+                             h.value, w.value)
+    if rc != 0:
+        return None
+    return out
+
+
+def normalize(img_u8: np.ndarray, mean: Optional[np.ndarray],
+              scale: float) -> Optional[np.ndarray]:
+    """(img - mean) * scale in native code; None if lib unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    img_u8 = np.ascontiguousarray(img_u8, np.uint8)
+    out = np.empty(img_u8.shape, np.float32)
+    mp = None
+    if mean is not None:
+        mean = np.ascontiguousarray(mean, np.float32)
+        if mean.size != img_u8.size:
+            return None
+        mp = mean.ctypes.data_as(ctypes.c_void_p)
+    lib.cxn_normalize(img_u8.ctypes.data_as(ctypes.c_void_p), mp,
+                      ctypes.c_float(scale),
+                      out.ctypes.data_as(ctypes.c_void_p), img_u8.size)
+    return out

@@ -160,6 +160,13 @@ class Layer:
     def init_params(self, key: jax.Array, in_shapes: List[Shape3]) -> Params:
         return {}
 
+    def param_pspecs(self) -> Dict[str, Any]:
+        """Tensor-parallel PartitionSpec tuples per param key (missing =
+        replicated). Layers with large weights override to shard over the
+        mesh 'model' axis — the general form of the reference's
+        fullc_gather hybrid parallelism (async_updater-inl.hpp:68-94)."""
+        return {}
+
     def init_state(self, in_shapes: List[Shape3]) -> State:
         return {}
 

@@ -17,6 +17,7 @@ from typing import List
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from .base import ApplyCtx, Layer, Shape3, is_flat, register_layer
@@ -41,8 +42,10 @@ class ConvolutionLayer(Layer):
             raise ValueError(f"conv {self.name!r}: kernel_size must be set")
         if c % hp.num_group or hp.num_channel % hp.num_group:
             raise ValueError(f"conv {self.name!r}: channels must divide ngroup")
-        if hp.kernel_height > y or hp.kernel_width > x:
-            raise ValueError(f"conv {self.name!r}: kernel size exceeds input")
+        if hp.kernel_height > y + 2 * hp.pad_y or \
+                hp.kernel_width > x + 2 * hp.pad_x:
+            raise ValueError(
+                f"conv {self.name!r}: kernel size exceeds padded input")
         oy = (y + 2 * hp.pad_y - hp.kernel_height) // hp.stride + 1
         ox = (x + 2 * hp.pad_x - hp.kernel_width) // hp.stride + 1
         self._cin = c
@@ -64,16 +67,25 @@ class ConvolutionLayer(Layer):
         hp = self.hp
         x = inputs[0].astype(ctx.compute_dtype)
         w = params["wmat"].astype(ctx.compute_dtype)
+        # compute-dtype in, compute-dtype out: the MXU accumulates bf16
+        # matmuls in f32 internally, and keeping activations in bf16
+        # halves HBM traffic (mixed preferred_element_type would also break
+        # the transpose/backward conv with mixed-dtype operands)
         y = lax.conv_general_dilated(
             x, w,
             window_strides=(hp.stride, hp.stride),
             padding=((hp.pad_y, hp.pad_y), (hp.pad_x, hp.pad_x)),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            feature_group_count=hp.num_group,
-            preferred_element_type=jnp.float32)
+            feature_group_count=hp.num_group)
         if "bias" in params:
-            y = y + params["bias"]
+            y = y + params["bias"].astype(y.dtype)
         return [y], state
+
+    def param_pspecs(self):
+        if self.hp.num_group > 1:
+            return {}    # grouped conv: keep replicated (group dim conflicts)
+        # output-channel (Megatron-style) sharding of the HWIO filter
+        return {"wmat": (None, None, None, "model"), "bias": ("model",)}
 
 
 def _pool_geometry(size: int, k: int, s: int, p: int):
@@ -100,8 +112,10 @@ class _PoolingLayer(Layer):
         hp = self.hp
         if hp.kernel_height <= 0 or hp.kernel_width <= 0:
             raise ValueError(f"{self.spec.type} {self.name!r}: must set kernel_size")
-        if hp.kernel_width > x or hp.kernel_height > y:
-            raise ValueError(f"{self.spec.type} {self.name!r}: kernel exceeds input")
+        if hp.kernel_height > y + 2 * hp.pad_y or \
+                hp.kernel_width > x + 2 * hp.pad_x:
+            raise ValueError(
+                f"{self.spec.type} {self.name!r}: kernel exceeds padded input")
         oy, self._extra_y = _pool_geometry(y, hp.kernel_height, hp.stride, hp.pad_y)
         ox, self._extra_x = _pool_geometry(x, hp.kernel_width, hp.stride, hp.pad_x)
         return [(c, oy, ox)]
@@ -119,8 +133,11 @@ class _PoolingLayer(Layer):
                (hp.pad_y, hp.pad_y + self._extra_y),
                (hp.pad_x, hp.pad_x + self._extra_x),
                (0, 0))
+        # init must be a *numpy* scalar: a jnp constant becomes a tracer
+        # under jit (jax>=0.9), defeating lax.reduce_window's monoid
+        # detection and hitting the non-differentiable generic path
         y = lax.reduce_window(
-            x, jnp.asarray(init, x.dtype), op,
+            x, np.asarray(init, x.dtype), op,
             window_dimensions=(1, hp.kernel_height, hp.kernel_width, 1),
             window_strides=(1, hp.stride, hp.stride, 1),
             padding=pad)

@@ -58,11 +58,15 @@ class FullConnectLayer(Layer):
     def apply(self, params, state, inputs, ctx):
         x = _flat2d(inputs[0])
         w = params["wmat"].astype(ctx.compute_dtype)
-        y = jnp.dot(x.astype(ctx.compute_dtype), w,
-                    preferred_element_type=jnp.float32)
+        y = jnp.dot(x.astype(ctx.compute_dtype), w)
         if "bias" in params:
-            y = y + params["bias"]
+            y = y + params["bias"].astype(y.dtype)
         return [_as_node(y)], state
+
+    def param_pspecs(self):
+        # column-parallel over the hidden dim: out features sharded on
+        # 'model'; GSPMD all-gathers at the next consumer when needed
+        return {"wmat": (None, "model"), "bias": ("model",)}
 
 
 class _ActivationLayer(Layer):

@@ -59,7 +59,8 @@ class SoftmaxLayer(LossLayerBase):
 
     def apply(self, params, state, inputs, ctx):
         x = inputs[0]
-        logits = x.reshape(x.shape[0], -1)
+        # softmax in f32 even when activations are bf16 (loss precision)
+        logits = x.reshape(x.shape[0], -1).astype(jnp.float32)
         probs = jax.nn.softmax(logits, axis=-1)
         return [probs.reshape(x.shape)], state
 

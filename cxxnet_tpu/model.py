@@ -153,6 +153,19 @@ class Network:
         assert result.nodes is not None, "apply(capture_nodes=True) required"
         return result.nodes[name]
 
+    def param_pspecs(self) -> Dict[str, Any]:
+        """PartitionSpec tree matching init()'s params for tensor-parallel
+        placement over the mesh 'model' axis (size-1 axis = replicated, so
+        this is always safe to apply)."""
+        specs: Dict[str, Any] = {}
+        for spec, layer in zip(self.graph.layers, self.layers):
+            if spec.is_shared or not layer.has_params:
+                continue
+            ps = layer.param_pspecs()
+            if ps:
+                specs[layer.name] = ps
+        return specs
+
     # -- introspection -----------------------------------------------------
     def param_tag(self, layer_name: str, param_name: str) -> str:
         """Tag used for lr/wd scoping: 'wmat' or 'bias'

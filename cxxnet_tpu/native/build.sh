@@ -1,0 +1,8 @@
+#!/bin/sh
+# Build the native data-plane library (JPEG decode + record scan).
+# Mirrors the role of the reference's Makefile USE_OPENCV_DECODER=0 path
+# (libjpeg fallback decoder, src/utils/decoder.h).
+set -e
+cd "$(dirname "$0")"
+g++ -O3 -march=native -fPIC -shared -o libcxxnet_native.so decode.cc -ljpeg
+echo "built $(pwd)/libcxxnet_native.so"

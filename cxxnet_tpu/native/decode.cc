@@ -1,0 +1,136 @@
+// Native data-plane kernels: JPEG decode + record scanning.
+//
+// TPU-native replacement for the reference's native IO substrate: the
+// libjpeg decoder (/root/reference/src/utils/decoder.h:21-115) and the
+// OpenMP parallel decode loop in the imgrec parser
+// (/root/reference/src/io/iter_image_recordio-inl.hpp:206-250). Exposed as
+// a plain C ABI consumed via ctypes (cxxnet_tpu/io/native.py); every entry
+// point is thread-safe so a Python thread pool gets true parallel decode
+// (ctypes releases the GIL for the duration of the call).
+//
+// Build: cxxnet_tpu/native/build.sh  ->  libcxxnet_native.so
+
+#include <csetjmp>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+#include <jpeglib.h>
+
+namespace {
+
+struct ErrorMgr {
+  jpeg_error_mgr pub;
+  jmp_buf jump;
+};
+
+void error_exit(j_common_ptr cinfo) {
+  ErrorMgr* mgr = reinterpret_cast<ErrorMgr*>(cinfo->err);
+  longjmp(mgr->jump, 1);
+}
+
+void silent_output(j_common_ptr) {}
+
+}  // namespace
+
+extern "C" {
+
+// Query the dimensions of a JPEG. Returns 0 on success.
+int cxn_jpeg_dims(const uint8_t* buf, long len, int* h, int* w, int* c) {
+  jpeg_decompress_struct cinfo;
+  ErrorMgr err;
+  cinfo.err = jpeg_std_error(&err.pub);
+  err.pub.error_exit = error_exit;
+  err.pub.output_message = silent_output;
+  if (setjmp(err.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(buf), len);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  *h = cinfo.image_height;
+  *w = cinfo.image_width;
+  *c = cinfo.num_components;
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+// Decode a JPEG into `out` (row-major HWC, uint8), which must hold
+// h*w*want_c bytes (dims from cxn_jpeg_dims). want_c: 3 = RGB, 1 = gray.
+// Returns 0 on success.
+int cxn_jpeg_decode(const uint8_t* buf, long len, int want_c, uint8_t* out,
+                    int out_h, int out_w) {
+  jpeg_decompress_struct cinfo;
+  ErrorMgr err;
+  cinfo.err = jpeg_std_error(&err.pub);
+  err.pub.error_exit = error_exit;
+  err.pub.output_message = silent_output;
+  if (setjmp(err.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(buf), len);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = (want_c == 1) ? JCS_GRAYSCALE : JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  if (static_cast<int>(cinfo.output_height) != out_h ||
+      static_cast<int>(cinfo.output_width) != out_w ||
+      static_cast<int>(cinfo.output_components) != want_c) {
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return -2;
+  }
+  const int stride = out_w * want_c;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    JSAMPROW row = out + static_cast<long>(cinfo.output_scanline) * stride;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+// Scan a record buffer for framed records (magic 0xCED7ABEF, see
+// cxxnet_tpu/io/recordio.py). Fills offsets[i], lengths[i] for up to
+// max_records payloads starting inside buf[0..len). Returns record count.
+int cxn_scan_records(const uint8_t* buf, long len, long* offsets,
+                     long* lengths, int max_records) {
+  const uint32_t kMagic = 0xCED7ABEFu;
+  long pos = 0;
+  int n = 0;
+  while (pos + 8 <= len && n < max_records) {
+    uint32_t magic, plen;
+    std::memcpy(&magic, buf + pos, 4);
+    if (magic != kMagic) {  // resync forward on 8-byte boundaries
+      pos += 8;
+      continue;
+    }
+    std::memcpy(&plen, buf + pos + 4, 4);
+    if (pos + 8 + plen > static_cast<unsigned long>(len)) break;
+    offsets[n] = pos + 8;
+    lengths[n] = plen;
+    ++n;
+    long adv = 8 + plen;
+    adv += (8 - adv % 8) % 8;
+    pos += adv;
+  }
+  return n;
+}
+
+// Subtract mean + scale in one pass: out[i] = (in[i] - mean[i]) * scale.
+// The hot inner loop of the augment stage (vectorized by the compiler).
+void cxn_normalize(const uint8_t* in, const float* mean, float scale,
+                   float* out, long n) {
+  if (mean) {
+    for (long i = 0; i < n; ++i) out[i] = (in[i] - mean[i]) * scale;
+  } else {
+    for (long i = 0; i < n; ++i) out[i] = in[i] * scale;
+  }
+}
+
+}  // extern "C"

@@ -179,6 +179,13 @@ class Optimizer:
     def _tag(self, param_name: str) -> str:
         return "bias" if param_name == "bias" else "wmat"
 
+    def state_pspecs(self, param_pspecs):
+        """PartitionSpec tree matching init_state(): momentum/moment buffers
+        shard exactly like their params; scalar counters replicate."""
+        if self.type == "adam":
+            return {"m1": param_pspecs, "m2": param_pspecs, "t": None}
+        return {"mom": param_pspecs}
+
     def schedules(self, epoch: int) -> Dict[str, Tuple[float, float]]:
         """Host-side schedule evaluation; pass the result into update()."""
         return {tag: h.schedule(epoch) for tag, h in self.hypers.items()}

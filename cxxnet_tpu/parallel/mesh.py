@@ -85,6 +85,60 @@ class MeshContext:
         sh = self.replicated()
         return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), tree)
 
+    @property
+    def model_parallel(self) -> int:
+        return self.mesh.shape[self.model_axis]
+
+    def named(self, spec) -> NamedSharding:
+        """PartitionSpec(-able) -> NamedSharding on this mesh."""
+        if spec is None:
+            return self.replicated()
+        if not isinstance(spec, P):
+            spec = P(*spec)
+        return NamedSharding(self.mesh, spec)
+
+    def shard_params(self, tree, pspec_tree):
+        """Place a params-like pytree with per-leaf PartitionSpecs.
+
+        ``pspec_tree`` mirrors ``tree`` but may omit subtrees/leaves (missing
+        = replicated). This is the TPU-native generalization of the
+        reference's fullc_gather model-parallel trick
+        (async_updater-inl.hpp:68-94): instead of gathering activations and
+        computing dW redundantly, big weights are sharded over the 'model'
+        axis and GSPMD inserts the collectives.
+        """
+        def usable(spec_sub, shape) -> bool:
+            """A spec is usable only when every sharded dim divides evenly;
+            otherwise fall back to replicated (e.g. nhidden=10 over a
+            4-way model axis)."""
+            for dim, axis in enumerate(spec_sub):
+                if axis is None:
+                    continue
+                if dim >= len(shape) or shape[dim] % self.mesh.shape[axis]:
+                    return False
+            return True
+
+        def place(sub, spec_sub):
+            if isinstance(sub, dict):
+                return {k: place(v, (spec_sub or {}).get(k)
+                                 if isinstance(spec_sub, dict) else None)
+                        for k, v in sub.items()}
+            if spec_sub is not None and not usable(spec_sub, np.shape(sub)):
+                spec_sub = None
+            return jax.device_put(sub, self.named(spec_sub))
+        return place(tree, pspec_tree)
+
+    def gather(self, tree):
+        """Bring a (possibly model-sharded) pytree to fully-replicated form
+        so host-side fetches (np.asarray for checkpoints / get_weight) work
+        in multi-host runs where each process only holds its local shards."""
+        sh = self.replicated()
+        def g(x):
+            if hasattr(x, "sharding") and x.sharding.is_fully_replicated:
+                return x
+            return jax.device_put(x, sh)
+        return jax.tree_util.tree_map(g, tree)
+
 
 def make_mesh_context(dev: str = "tpu",
                       devices: Optional[Sequence] = None,

@@ -92,61 +92,73 @@ class Trainer:
                 f"degree {self.mesh.data_parallel}")
 
     # -- model lifecycle ---------------------------------------------------
+    def _place(self, params, net_state=None, opt_state=None):
+        """Shard params (TP specs from the layers; size-1 model axis =
+        replicated), mirror the sharding onto optimizer state, replicate
+        the small net state."""
+        pspecs = self.net.param_pspecs()
+        out = [self.mesh.shard_params(params, pspecs)]
+        if net_state is not None:
+            out.append(self.mesh.replicate(net_state))
+        if opt_state is not None:
+            out.append(self.mesh.shard_params(
+                opt_state, self.optimizer.state_pspecs(pspecs)))
+        return out[0] if len(out) == 1 else tuple(out)
+
+    def _init_accum(self, params) -> None:
+        if self.update_period > 1:
+            self.accum = self.mesh.shard_params(
+                jax.tree_util.tree_map(jnp.zeros_like, params),
+                self.net.param_pspecs())
+
     def init_model(self) -> None:
         params, net_state = self.net.init(self._base_key)
-        self.params = self.mesh.replicate(params)
-        self.net_state = self.mesh.replicate(net_state)
-        self.opt_state = self.mesh.replicate(self.optimizer.init_state(params))
-        if self.update_period > 1:
-            self.accum = self.mesh.replicate(
-                jax.tree_util.tree_map(jnp.zeros_like, params))
+        self.params, self.net_state, self.opt_state = self._place(
+            params, net_state, self.optimizer.init_state(params))
+        self._init_accum(params)
 
     def save_model(self, path: str) -> None:
         ckpt.save_model(
             path, structure_sig=self.graph.structure_signature(),
             round_counter=self.round_counter, epoch_counter=self.epoch_counter,
-            params=self.params, net_state=self.net_state,
-            opt_state=self.opt_state)
+            params=self.mesh.gather(self.params), net_state=self.net_state,
+            opt_state=self.mesh.gather(self.opt_state))
 
     def load_model(self, path: str) -> None:
         blob = ckpt.load_model(path)
         ckpt.check_structure(blob["meta"], self.graph.structure_signature())
-        self.params = self.mesh.replicate(blob["params"])
-        self.net_state = self.mesh.replicate(blob["state"])
-        if blob["opt"] is not None:
-            self.opt_state = self.mesh.replicate(blob["opt"])
-        else:
-            self.opt_state = self.mesh.replicate(
-                self.optimizer.init_state(blob["params"]))
-        if self.update_period > 1:
-            self.accum = self.mesh.replicate(
-                jax.tree_util.tree_map(jnp.zeros_like, blob["params"]))
+        opt = blob["opt"] if blob["opt"] is not None \
+            else self.optimizer.init_state(blob["params"])
+        self.params, self.net_state, self.opt_state = self._place(
+            blob["params"], blob["state"], opt)
+        self._init_accum(blob["params"])
         self.round_counter = blob["meta"]["round"]
         self.epoch_counter = blob["meta"]["epoch"]
 
     def copy_model_from(self, path: str) -> None:
         """Finetune restore: name-matched layer copy from another model."""
         blob = ckpt.load_model(path)
-        fresh = ckpt.jax_to_numpy(self.params)
+        fresh = ckpt.jax_to_numpy(self.mesh.gather(self.params))
         merged = ckpt.copy_model_from(fresh, blob["params"],
                                       verbose=not self.silent)
-        self.params = self.mesh.replicate(merged)
+        self.params = self._place(merged)
 
     def start_round(self, round_counter: int) -> None:
         self.round_counter = round_counter
 
     # -- weights API (reference SetWeight/GetWeight, nnet.h:69-91) ---------
     def get_weight(self, layer_name: str, tag: str) -> np.ndarray:
-        return np.asarray(self.params[layer_name][tag])
+        return np.asarray(self.mesh.gather(self.params[layer_name][tag]))
 
     def set_weight(self, weight: np.ndarray, layer_name: str, tag: str) -> None:
         cur = self.params[layer_name][tag]
         if tuple(weight.shape) != tuple(cur.shape):
             raise ValueError(
                 f"set_weight: shape {weight.shape} != {tuple(cur.shape)}")
-        p = ckpt.jax_to_numpy(self.params)
-        p[layer_name][tag] = np.asarray(weight, dtype=np.asarray(cur).dtype)
-        self.params = self.mesh.replicate(p)
+        p = ckpt.jax_to_numpy(self.mesh.gather(self.params))
+        p[layer_name][tag] = np.asarray(weight,
+                                        dtype=p[layer_name][tag].dtype)
+        self.params = self._place(p)
 
     # -- train step --------------------------------------------------------
     def _needed_nodes(self) -> List[str]:

@@ -1,0 +1,196 @@
+#!/usr/bin/env python
+"""Generate the Inception-BN (GoogLeNet + Batch Normalization) network config.
+
+The reference ships this architecture as a hand-written 694-line config
+(/root/reference/example/ImageNet/Inception-BN.conf); here the repetitive
+inception blocks are emitted programmatically from the block table of the BN
+paper (Ioffe & Szegedy, arXiv:1502.03167, Table 1 / GoogLeNet variant), which
+is the same topology the reference config encodes.
+
+Usage:
+    python gen_inception_bn.py [--scale 1.0] [--image-size 224]
+                               [--num-class 1000] [-o inception_bn.conf]
+
+``--scale`` multiplies every channel count (for fast tests / dry runs);
+``--image-size`` must be a multiple of 32.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+
+# Per-block channel table: (name, 1x1, (3x3 reduce, 3x3),
+#                           (double3x3 reduce, double3x3), pool kind, proj, stride)
+# stride-2 blocks drop the 1x1 branch and use a projection-free max pool.
+INCEPTION_TABLE = [
+    ("3a", 64,  (64, 64),   (64, 96),   "avg", 32,  1),
+    ("3b", 64,  (64, 96),   (64, 96),   "avg", 64,  1),
+    ("3c", 0,   (128, 160), (64, 96),   "max", 0,   2),
+    ("4a", 224, (64, 96),   (96, 128),  "avg", 128, 1),
+    ("4b", 192, (96, 128),  (96, 128),  "avg", 128, 1),
+    ("4c", 160, (128, 160), (128, 160), "avg", 128, 1),
+    ("4d", 96,  (128, 192), (160, 192), "avg", 128, 1),
+    ("4e", 0,   (128, 192), (192, 256), "max", 0,   2),
+    ("5a", 352, (192, 320), (160, 224), "avg", 128, 1),
+    ("5b", 352, (192, 320), (192, 224), "max", 128, 1),
+]
+
+
+class ConfWriter:
+    def __init__(self, scale: float):
+        self.buf = io.StringIO()
+        self.scale = scale
+        self._anon = 0
+
+    def ch(self, c: int) -> int:
+        """Scaled channel count, floored to a multiple of 4, min 4."""
+        return max(4, int(c * self.scale) // 4 * 4)
+
+    def line(self, s: str = "") -> None:
+        self.buf.write(s + "\n")
+
+    def conv_bn_relu(self, src: str, dst: str, name: str, nchannel: int,
+                     kernel: int, stride: int = 1, pad: int = 0) -> None:
+        a, b = f"{dst}%a", f"{dst}%b"
+        self.line(f"layer[{src}->{a}] = conv:cv_{name}")
+        self.line(f"  kernel_size = {kernel}")
+        self.line(f"  nchannel = {self.ch(nchannel)}")
+        self.line(f"  stride = {stride}")
+        self.line(f"  pad = {pad}")
+        self.line(f"  no_bias = 1")
+        self.line(f"layer[{a}->{b}] = batch_norm:bn_{name}")
+        self.line(f"layer[{b}->{dst}] = relu:ac_{name}")
+
+    def pool(self, src: str, dst: str, name: str, kind: str, kernel: int,
+             stride: int, pad: int = 0) -> None:
+        self.line(f"layer[{src}->{dst}] = {kind}_pooling:pool_{name}")
+        self.line(f"  kernel_size = {kernel}")
+        self.line(f"  stride = {stride}")
+        if pad:
+            self.line(f"  pad = {pad}")
+
+    def inception(self, src: str, dst: str, name: str, c1: int, c3, cd3,
+                  pool_kind: str, proj: int, stride: int) -> None:
+        """One inception block: 4-way split -> branches -> channel concat."""
+        self.line(f"##### inception {name} #####")
+        branches = []
+        tips = []
+        if c1 > 0:
+            branches.append("b1")
+        branches += ["b2", "b3", "bp"]
+        heads = {b: f"{name}.{b}.0" for b in branches}
+        self.line(f"layer[{src}->{','.join(heads[b] for b in branches)}] "
+                  f"= split:sp_{name}")
+        if c1 > 0:
+            t = f"{name}.b1.1"
+            self.conv_bn_relu(heads['b1'], t, f"{name}_1x1", c1, 1)
+            tips.append(t)
+        # 3x3 branch: 1x1 reduce then 3x3 (stride of the block)
+        r, o = c3
+        mid = f"{name}.b2.1"
+        self.conv_bn_relu(heads["b2"], mid, f"{name}_3x3r", r, 1)
+        t = f"{name}.b2.2"
+        self.conv_bn_relu(mid, t, f"{name}_3x3", o, 3, stride=stride, pad=1)
+        tips.append(t)
+        # double-3x3 branch: 1x1 reduce, 3x3, 3x3 (second carries the stride)
+        r, o = cd3
+        m1, m2 = f"{name}.b3.1", f"{name}.b3.2"
+        self.conv_bn_relu(heads["b3"], m1, f"{name}_d3x3r", r, 1)
+        self.conv_bn_relu(m1, m2, f"{name}_d3x3a", o, 3, pad=1)
+        t = f"{name}.b3.3"
+        self.conv_bn_relu(m2, t, f"{name}_d3x3b", o, 3, stride=stride, pad=1)
+        tips.append(t)
+        # pool branch: 3x3 pool (+ 1x1 projection unless stride-2 passthrough)
+        pt = f"{name}.bp.1"
+        self.pool(heads["bp"], pt, f"{name}", pool_kind, 3, stride,
+                  pad=0 if stride == 2 else 1)
+        if proj > 0:
+            t = f"{name}.bp.2"
+            self.conv_bn_relu(pt, t, f"{name}_proj", proj, 1)
+            tips.append(t)
+        else:
+            tips.append(pt)
+        self.line(f"layer[{','.join(tips)}->{dst}] = ch_concat:cc_{name}")
+        self.line()
+
+
+def generate(scale: float = 1.0, image_size: int = 224,
+             num_class: int = 1000, batch_size: int = 128,
+             with_data: bool = True, data_prefix: str = "data/imagenet") -> str:
+    if image_size % 32:
+        raise ValueError("image_size must be a multiple of 32")
+    w = ConfWriter(scale)
+    w.line("# Inception-BN, generated by gen_inception_bn.py -- do not edit")
+    w.line(f"# scale={scale} image_size={image_size} num_class={num_class}")
+    if with_data:
+        w.line("data = train")
+        w.line("iter = imgrec")
+        w.line(f'  image_rec = "{data_prefix}_train.rec"')
+        w.line(f'  image_mean = "{data_prefix}_mean.bin"')
+        w.line("  rand_crop = 1")
+        w.line("  rand_mirror = 1")
+        w.line("  shuffle = 1")
+        w.line("iter = threadbuffer")
+        w.line("iter = end")
+        w.line()
+        w.line("eval = val")
+        w.line("iter = imgrec")
+        w.line(f'  image_rec = "{data_prefix}_val.rec"')
+        w.line(f'  image_mean = "{data_prefix}_mean.bin"')
+        w.line("iter = end")
+        w.line()
+    w.line("netconfig = start")
+    # stem: 7x7/2 -> pool -> 1x1 -> 3x3 -> pool
+    w.conv_bn_relu("in", "s1", "stem1", 64, 7, stride=2, pad=3)
+    w.pool("s1", "s2", "stem1", "max", 3, 2)
+    w.conv_bn_relu("s2", "s3", "stem2r", 64, 1)
+    w.conv_bn_relu("s3", "s4", "stem2", 192, 3, pad=1)
+    w.pool("s4", "i2", "stem2", "max", 3, 2)
+    w.line()
+    top = "i2"
+    for (name, c1, c3, cd3, pk, proj, stride) in INCEPTION_TABLE:
+        dst = f"i_{name}"
+        w.inception(top, dst, name, c1, c3, cd3, pk, proj, stride)
+        top = dst
+    final = image_size // 32
+    w.pool(top, "gap", "global", "avg", final, 1)
+    w.line("layer[gap->flat] = flatten:flat")
+    w.line("layer[flat->fc] = fullc:fc1")
+    w.line(f"  nhidden = {num_class}")
+    w.line("  random_type = xavier")
+    w.line("layer[fc->fc] = softmax:loss")
+    w.line("netconfig = end")
+    w.line()
+    w.line(f"input_shape = 3,{image_size},{image_size}")
+    w.line(f"batch_size = {batch_size}")
+    w.line()
+    w.line("dev = tpu")
+    w.line("updater = sgd")
+    w.line("eta = 0.1")
+    w.line("momentum = 0.9")
+    w.line("wd = 0.0001")
+    w.line("compute_dtype = bfloat16")
+    w.line("num_round = 40")
+    w.line("metric = rec@1")
+    w.line("metric = rec@5")
+    return w.buf.getvalue()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--num-class", type=int, default=1000)
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("-o", "--output", default="inception_bn.conf")
+    args = ap.parse_args()
+    text = generate(args.scale, args.image_size, args.num_class,
+                    args.batch_size)
+    with open(args.output, "w") as f:
+        f.write(text)
+    print(f"wrote {args.output} ({len(text.splitlines())} lines)")
+
+
+if __name__ == "__main__":
+    main()

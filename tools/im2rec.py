@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Pack an image list into a record file (and optionally shard it).
+
+Reference parity: tools/im2rec.cc (and the legacy im2bin.cpp / bin2rec.cc —
+this framework standardizes on one record format, so one tool covers all
+three). Reads a ``.lst`` file (``index  label[ label2 ...]  relpath`` per
+line, same layout the reference uses), optionally resizes the short edge,
+and writes cxxnet_tpu recordio shards.
+
+Usage:
+    python tools/im2rec.py train.lst image_root/ train.rec \
+        [--resize 256] [--quality 90] [--nsplit 4] [--label-width 1]
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cxxnet_tpu.io.recordio import ImageRecord, RecordWriter, read_image_list
+
+
+def resize_short(img, size: int):
+    from PIL import Image
+    w, h = img.size
+    if min(w, h) == size:
+        return img
+    if w < h:
+        nw, nh = size, int(h * size / w + 0.5)
+    else:
+        nw, nh = int(w * size / h + 0.5), size
+    return img.resize((nw, nh), Image.BILINEAR)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("lst", help="image list file")
+    ap.add_argument("root", help="image root directory")
+    ap.add_argument("out", help="output .rec path")
+    ap.add_argument("--resize", type=int, default=0,
+                    help="resize short edge to this many pixels")
+    ap.add_argument("--quality", type=int, default=90)
+    ap.add_argument("--nsplit", type=int, default=1,
+                    help="write N shard files out.rec.0..N-1")
+    ap.add_argument("--part", type=int, default=-1,
+                    help="only write this shard (for parallel packing)")
+    args = ap.parse_args()
+
+    from PIL import Image
+
+    items = read_image_list(args.lst)
+    nsplit = max(1, args.nsplit)
+    for part in range(nsplit):
+        if args.part >= 0 and part != args.part:
+            continue
+        path = args.out if nsplit == 1 else f"{args.out}.{part}"
+        lo = len(items) * part // nsplit
+        hi = len(items) * (part + 1) // nsplit
+        n = 0
+        with RecordWriter(path) as w:
+            for idx, labels, rel in items[lo:hi]:
+                fp = os.path.join(args.root, rel)
+                with Image.open(fp) as im:
+                    im = im.convert("RGB")
+                    if args.resize:
+                        im = resize_short(im, args.resize)
+                    buf = io.BytesIO()
+                    im.save(buf, "JPEG", quality=args.quality)
+                w.write(ImageRecord(inst_id=idx, labels=labels,
+                                    data=buf.getvalue()).pack())
+                n += 1
+                if n % 1000 == 0:
+                    print(f"{path}: {n} images", flush=True)
+        print(f"wrote {path}: {n} images")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Generate a .lst image list from a class-per-directory image tree, with
+optional train/val split and shuffling.
+
+Reference parity: tools/imgbin-partition-maker.py + the list-prep steps in
+example/kaggle_bowl/gen_train.py — the ``index  label  relpath`` list format
+consumed by im2rec.
+
+Usage:
+    python tools/make_list.py image_root/ out_prefix \
+        [--train-ratio 0.9] [--seed 0] [--exts .jpg,.jpeg,.png]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("root")
+    ap.add_argument("prefix")
+    ap.add_argument("--train-ratio", type=float, default=1.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--exts", default=".jpg,.jpeg,.png")
+    args = ap.parse_args()
+
+    exts = tuple(args.exts.lower().split(","))
+    classes = sorted(d for d in os.listdir(args.root)
+                     if os.path.isdir(os.path.join(args.root, d)))
+    items = []
+    for li, cls in enumerate(classes):
+        cdir = os.path.join(args.root, cls)
+        for fn in sorted(os.listdir(cdir)):
+            if fn.lower().endswith(exts):
+                items.append((li, os.path.join(cls, fn)))
+    rng = random.Random(args.seed)
+    rng.shuffle(items)
+    ntrain = int(len(items) * args.train_ratio)
+
+    def write(path, sub, base):
+        with open(path, "w") as f:
+            for i, (lab, rel) in enumerate(sub):
+                f.write(f"{base + i}\t{lab}\t{rel}\n")
+        print(f"wrote {path}: {len(sub)} items")
+
+    if args.train_ratio < 1.0:
+        write(args.prefix + "_train.lst", items[:ntrain], 0)
+        write(args.prefix + "_val.lst", items[ntrain:], ntrain)
+    else:
+        write(args.prefix + ".lst", items, 0)
+    with open(args.prefix + "_classes.txt", "w") as f:
+        for c in classes:
+            f.write(c + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
