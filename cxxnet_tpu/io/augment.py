@@ -113,6 +113,22 @@ class AugmentParams:
                 or self.max_random_scale != 1.0)
 
 
+def mean_cache_path(p: AugmentParams) -> str:
+    """Path of the cached mean image (.npy suffix appended when absent)."""
+    path = p.mean_img
+    if path and not path.endswith(".npy"):
+        path = path + ".npy"
+    return path
+
+
+def pack_label(labels, width: int) -> np.ndarray:
+    """Zero-pad/truncate a label vector to ``label_width`` entries."""
+    out = np.zeros((width,), np.float32)
+    w = min(width, len(labels))
+    out[:w] = labels[:w]
+    return out
+
+
 class ImageAugmenter:
     """Affine + crop + photometric augmentation of one HWC float image."""
 
@@ -135,16 +151,24 @@ class ImageAugmenter:
             if p.max_aspect_ratio > 0 else 1.0
         shear = rng.uniform(-p.max_shear_ratio, p.max_shear_ratio) \
             if p.max_shear_ratio > 0 else 0.0
+        h, w = img.shape[:2]
         if p.min_crop_size > 0 and p.max_crop_size + 1 > p.min_crop_size:
             crop = rng.randint(p.min_crop_size, p.max_crop_size + 1)
             scale = float(self.out_y) / crop
         else:
             scale = rng.uniform(p.min_random_scale, p.max_random_scale)
-        hs, ws = scale * ratio, scale / max(ratio, 1e-8)
+        # Bound the effective content scale so the scaled image size stays in
+        # [min_img_size, max_img_size]. Intentional semantic difference from
+        # the reference (image_augmenter-inl.hpp:92-94), which clamps the
+        # warp canvas size while keeping content scale: here the affine
+        # renders straight into the output crop, so the size bound is
+        # expressed as a scale bound instead.
+        hscale = np.clip(scale * h, p.min_img_size, p.max_img_size) / h
+        wscale = np.clip(scale * w, p.min_img_size, p.max_img_size) / w
+        hs, ws = hscale * ratio, wscale / max(ratio, 1e-8)
         cos_a, sin_a = np.cos(a), np.sin(a)
         m = np.array([[cos_a * ws, (sin_a + shear) * hs, 0.0],
                       [-sin_a * ws, (cos_a + shear) * hs, 0.0]], np.float32)
-        h, w = img.shape[:2]
         m[0, 2] = self.out_x / 2.0 - (m[0, 0] * w / 2.0 + m[0, 1] * h / 2.0)
         m[1, 2] = self.out_y / 2.0 - (m[1, 0] * w / 2.0 + m[1, 1] * h / 2.0)
         fv = float(self.p.fill_value)

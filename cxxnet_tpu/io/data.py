@@ -77,7 +77,7 @@ def create_iterator(cfg: ConfigPairs) -> DataIter:
     data.cpp:27-94): each ``iter = <type>`` entry creates an iterator wrapping
     the previous one; every other pair is passed to all iterators in the
     chain (each ignores settings it does not understand)."""
-    from . import proc, iter_imgrec  # noqa: F401  (populate registry)
+    from . import proc, iter_imgrec, iter_img  # noqa: F401  (populate registry)
     kinds = [v for k, v in cfg if k == "iter"]
     params = [(k, v) for k, v in cfg if k != "iter"]
     it: Optional[DataIter] = None

@@ -1,0 +1,169 @@
+"""Direct image-file iterator (``img``) and side-feature join (``attachtxt``).
+
+Reference analogs:
+  * ImageIterator (/root/reference/src/io/iter_img-inl.hpp:17-138): reads a
+    ``.lst`` file (``index<TAB>label...<TAB>relative/path``) and loads each
+    image straight from disk (OpenCV imread there; PIL/native decoder here),
+    with shuffle and multi-label support. The reference emits DataInst and
+    relies on a separate batcher; here batching/augmentation are built in,
+    matching this framework's batched iterator protocol.
+  * AttachTxtIterator (/root/reference/src/io/iter_attach_txt-inl.hpp:15-101):
+    decorator that joins per-instance side features (text file: first token is
+    the feature dim, then ``inst_id f_1 .. f_dim`` rows) into
+    ``batch.extra_data`` by instance id, feeding the graph's ``in_1..`` extra
+    input nodes (nnet_config.h:229-252).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from .data import DataBatch, DataIter, register_iter
+from .augment import (AugmentParams, ImageAugmenter, MeanStore,
+                      mean_cache_path, pack_label)
+from .recordio import read_image_list
+
+
+@register_iter("img")
+class ImageIterator(DataIter):
+    """Per-file image loader driven by an image list file."""
+
+    def set_param(self, name, val):
+        if name in ("image_list", "path_imglist"):
+            self.list_path = val
+        elif name in ("image_root", "path_imgdir"):
+            self.root = val
+        elif name == "batch_size":
+            self.batch_size = int(val)
+        elif name == "input_shape":
+            self.input_shape = tuple(int(x) for x in val.split(","))
+        elif name == "shuffle":
+            self.shuffle = int(val)
+        elif name == "seed_data":
+            self.seed = int(val)
+        elif name == "label_width":
+            self.label_width = int(val)
+        elif name == "silent":
+            self.silent = int(val)
+        else:
+            self.aug.set_param(name, val)
+
+    def __init__(self, cfg):
+        self.list_path = ""
+        self.root = ""
+        self.batch_size = 128
+        self.input_shape = None
+        self.shuffle = 0
+        self.seed = 0
+        self.label_width = 1
+        self.silent = 0
+        self.aug = AugmentParams()
+        super().__init__(cfg)
+
+    def init(self):
+        if not self.list_path:
+            raise ValueError("img: image_list must be set")
+        if self.input_shape is None:
+            raise ValueError("img: input_shape must be set")
+        c, y, x = self.input_shape
+        self.augmenter = ImageAugmenter(self.aug, (c, y, x))
+        self.mean = MeanStore(mean_cache_path(self.aug), (y, x, c))
+        self.items = []          # (inst_id, labels, filename)
+        for idx, labels, fname in read_image_list(self.list_path):
+            self.items.append((idx, labels, fname))
+        if not self.silent:
+            print(f"ImageIterator: image_list={self.list_path} "
+                  f"({len(self.items)} images)")
+        self._order = np.arange(len(self.items))
+        self._rng = np.random.RandomState(self.seed)
+        if self.aug.mean_img and not self.mean.ready:
+            rng = np.random.RandomState(0)
+            self.mean.compute(self.augmenter.process(self._load(i), rng)
+                              for i in range(len(self.items)))
+        self.before_first()
+
+    def _load(self, i: int) -> np.ndarray:
+        from .iter_imgrec import decode_image
+        _, _, fname = self.items[i]
+        path = os.path.join(self.root, fname) if self.root else fname
+        with open(path, "rb") as f:
+            return decode_image(f.read(), self.input_shape[0])
+
+    def before_first(self):
+        if self.shuffle:
+            self._rng.shuffle(self._order)
+        self._pos = 0
+
+    def next(self) -> Optional[DataBatch]:
+        n = len(self.items)
+        if self._pos >= n:
+            return None
+        bs = self.batch_size
+        idx = self._order[self._pos:self._pos + bs]
+        self._pos += bs
+        padd = 0
+        if len(idx) < bs:
+            padd = bs - len(idx)
+            idx = np.concatenate([idx, np.repeat(idx[-1:], padd)])
+        imgs, labels, ids = [], [], []
+        for i in idx:
+            img = self.augmenter.process(self._load(int(i)), self._rng)
+            imgs.append(self.mean.apply(img, self.aug))
+            labels.append(pack_label(self.items[int(i)][1],
+                                     self.label_width))
+            ids.append(self.items[int(i)][0])
+        return DataBatch(data=np.stack(imgs), label=np.stack(labels),
+                         num_batch_padd=padd,
+                         inst_index=np.asarray(ids, np.int64))
+
+
+@register_iter("attachtxt")
+class AttachTxtIterator(DataIter):
+    """Join per-instance side features into ``batch.extra_data`` by id."""
+
+    def set_param(self, name, val):
+        if name == "filename":
+            self.filename = val
+
+    def __init__(self, cfg, base: DataIter):
+        self.filename = ""
+        self.base = base
+        super().__init__(cfg)
+
+    def init(self):
+        if not self.filename:
+            raise ValueError("attachtxt: filename must be set")
+        with open(self.filename) as f:
+            toks = f.read().split()
+        self.dim = int(toks[0])
+        self.table = {}
+        pos = 1
+        while pos < len(toks):
+            inst_id = int(toks[pos])
+            feat = np.asarray([float(t) for t in toks[pos + 1:pos + 1 + self.dim]],
+                              np.float32)
+            if feat.shape[0] != self.dim:
+                raise ValueError(
+                    "attachtxt: data do not match dimension specified")
+            self.table[inst_id] = feat
+            pos += 1 + self.dim
+
+    def before_first(self):
+        self.base.before_first()
+
+    def next(self) -> Optional[DataBatch]:
+        b = self.base.next()
+        if b is None:
+            return None
+        if b.inst_index is None:
+            raise ValueError("attachtxt: base iterator yields no inst_index")
+        extra = np.zeros((b.batch_size, 1, 1, self.dim), np.float32)
+        for row, inst_id in enumerate(np.asarray(b.inst_index)):
+            feat = self.table.get(int(inst_id))
+            if feat is not None:
+                extra[row, 0, 0, :] = feat
+        b.extra_data = list(b.extra_data) + [extra]
+        return b

@@ -24,25 +24,31 @@ import numpy as np
 
 from .data import DataBatch, DataIter, register_iter
 from .recordio import ImageRecord, RecordReader, read_image_list
-from .augment import AugmentParams, ImageAugmenter, MeanStore
+from .augment import (AugmentParams, ImageAugmenter, MeanStore,
+                      mean_cache_path, pack_label)
 
 
 def decode_image(data: bytes, want_channels: int = 3) -> np.ndarray:
-    """Decode jpeg/png bytes to HWC uint8 RGB (native decoder if built,
-    else PIL/cv2). Raw float tensors (flag==1 records) skip this."""
+    """Decode jpeg/png bytes to HWC uint8 (RGB, or single-channel luma when
+    ``want_channels == 1``) via the native decoder if built, else cv2/PIL.
+    Raw float tensors (flag==1 records) skip this."""
     from . import native
     arr = native.try_decode(data, want_channels)
     if arr is not None:
         return arr
+    gray = want_channels == 1
     try:
         import cv2
-        a = cv2.imdecode(np.frombuffer(data, np.uint8), cv2.IMREAD_COLOR)
+        flag = cv2.IMREAD_GRAYSCALE if gray else cv2.IMREAD_COLOR
+        a = cv2.imdecode(np.frombuffer(data, np.uint8), flag)
         if a is None:
             raise ValueError("cv2.imdecode failed")
-        return a[:, :, ::-1]      # BGR -> RGB
+        return a[:, :, None] if gray else a[:, :, ::-1]      # BGR -> RGB
     except ImportError:
         from PIL import Image
-        return np.asarray(Image.open(_io.BytesIO(data)).convert("RGB"))
+        img = Image.open(_io.BytesIO(data)).convert("L" if gray else "RGB")
+        a = np.asarray(img)
+        return a[:, :, None] if gray else a
 
 
 @register_iter("imgrec", "imgbin", "imgbinx", "imginst", "imgbinold")
@@ -102,24 +108,21 @@ class ImageRecordIterator(DataIter):
             raise ValueError("imgrec: input_shape must be set")
         c, y, x = self.input_shape
         self.augmenter = ImageAugmenter(self.aug, (c, y, x))
-        self.mean = MeanStore(self._mean_cache_path(), (y, x, c))
+        self.mean = MeanStore(mean_cache_path(self.aug), (y, x, c))
         self._label_map = None
         if self.list_path:
             self._label_map = {idx: lab for idx, lab, _
                                in read_image_list(self.list_path)}
         self._pool = futures.ThreadPoolExecutor(self.nthread)
         self._rng = np.random.RandomState(self.seed + 7 * self.rank)
-        self._epoch_rngs = [np.random.RandomState(self.seed * 131 + i)
-                            for i in range(self.nthread)]
+        # monotonically increasing per-item augmentation counter, hashed
+        # before seeding so streams are deterministic under any thread-pool
+        # schedule yet uncorrelated across seeds/ranks
+        self._item_counter = (self.seed << 32) ^ (self.rank << 56)
         if self.aug.mean_img and not self.mean.ready:
             self._compute_mean()
         self.before_first()
 
-    def _mean_cache_path(self) -> str:
-        p = self.aug.mean_img
-        if p and not p.endswith(".npy"):
-            p = p + ".npy"
-        return p
 
     def _reader(self) -> RecordReader:
         return RecordReader(self.rec_path, self.rank, self.nworker)
@@ -147,19 +150,25 @@ class ImageRecordIterator(DataIter):
         self._buf: List = []
         self._done = False
 
-    def _process_one(self, payload: bytes, tid: int):
+    @staticmethod
+    def _hash_seed(counter: int) -> int:
+        """splitmix64-style integer mix so consecutive counters (and
+        shifted seed/rank bases) yield uncorrelated RNG streams."""
+        z = (counter + 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
+        z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+        z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+        return (z ^ (z >> 31)) & 0x7FFFFFFF
+
+    def _process_one(self, payload: bytes, item_counter: int):
         rec = ImageRecord.unpack(payload)
-        img = self.augmenter.process(self._decode(rec),
-                                     self._epoch_rngs[tid % self.nthread])
+        rng = np.random.RandomState(self._hash_seed(item_counter))
+        img = self.augmenter.process(self._decode(rec), rng)
         img = self.mean.apply(img, self.aug)
         if self._label_map is not None and rec.inst_id in self._label_map:
             lab = self._label_map[rec.inst_id]
         else:
             lab = rec.labels
-        label = np.zeros((self.label_width,), np.float32)
-        w = min(self.label_width, len(lab))
-        label[:w] = lab[:w]
-        return img, label, rec.inst_id
+        return img, pack_label(lab, self.label_width), rec.inst_id
 
     def _fill(self, n: int) -> None:
         """Read up to n raw records, decode them on the pool."""
@@ -172,8 +181,9 @@ class ImageRecordIterator(DataIter):
             self._done = True
         if self.shuffle:
             self._rng.shuffle(raw)
-        out = list(self._pool.map(self._process_one, raw,
-                                  range(len(raw))))
+        seeds = range(self._item_counter, self._item_counter + len(raw))
+        self._item_counter += len(raw)
+        out = list(self._pool.map(self._process_one, raw, seeds))
         self._buf.extend(out)
 
     def next(self) -> Optional[DataBatch]:

@@ -72,14 +72,18 @@ class RecordReader:
         self._resync(self.begin)
 
     def _resync(self, pos: int) -> None:
-        """Seek to ``pos`` then scan forward to the next record magic."""
-        pos = pos - pos % 8
-        self._f.seek(pos)
+        """Seek to ``pos`` then scan forward to the next record magic.
+        If no magic exists in [pos, end) the reader lands on ``end`` and
+        yields nothing (a shard can legally be empty). The scan starts at
+        the next 8-aligned offset at-or-after ``pos`` — records start
+        8-aligned, and rounding down would re-read a record owned by the
+        previous shard (every shard reads the record spanning its end)."""
+        pos = pos + (-pos) % 8
         want = struct.pack("<I", MAGIC)
+        chunk_size = 1 << 16
         while pos < self.end:
-            chunk = self._f.read(1 << 16)
-            if not chunk:
-                return
+            self._f.seek(pos)
+            chunk = self._f.read(chunk_size)
             off = 0
             while True:
                 idx = chunk.find(want, off)
@@ -89,9 +93,11 @@ class RecordReader:
                     self._f.seek(pos + idx)
                     return
                 off = idx + 1
+            if len(chunk) < chunk_size:
+                break                    # hit EOF without finding a record
             # overlap 7 bytes in case magic straddles the chunk boundary
             pos += len(chunk) - 7
-            self._f.seek(pos)
+        self._f.seek(self.end)
 
     def __iter__(self) -> Iterator[bytes]:
         while True:

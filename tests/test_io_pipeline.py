@@ -141,3 +141,109 @@ def test_im2rec_tool(tmp_path):
     assert len(recs) == 6
     lst = read_image_list(str(tmp_path / "d.lst"))
     assert len(lst) == 6 and lst[0][1].shape == (1,)
+
+
+@pytest.fixture()
+def img_dir(tmp_path):
+    """6 gradient jpegs on disk + a .lst file referencing them."""
+    from PIL import Image
+    root = tmp_path / "raw"
+    os.makedirs(root)
+    lines = []
+    for i in range(6):
+        Image.fromarray(_grad_img(40, 40, i)).save(root / f"im{i}.jpg")
+        lines.append(f"{i}\t{i % 3}\tim{i}.jpg")
+    lst = tmp_path / "raw.lst"
+    lst.write_text("\n".join(lines) + "\n")
+    return str(lst), str(root)
+
+
+def test_img_iterator(img_dir):
+    lst, root = img_dir
+    cfg = [
+        ("iter", "img"),
+        ("image_list", lst),
+        ("image_root", root),
+        ("input_shape", "3,32,32"),
+        ("batch_size", "4"),
+        ("shuffle", "1"),
+        ("silent", "1"),
+        ("iter", "end"),
+    ]
+    it = create_iterator(cfg)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data.shape == (4, 32, 32, 3)
+    assert batches[1].num_batch_padd == 2
+    ids = np.concatenate([b.inst_index[:b.batch_size - b.num_batch_padd]
+                          for b in batches])
+    assert sorted(ids.tolist()) == list(range(6))
+    labs = {int(i): int(l) for b in batches
+            for i, l in zip(b.inst_index, b.label[:, 0])}
+    assert all(labs[i] == i % 3 for i in range(6))
+
+
+def test_attachtxt_iterator(img_dir, tmp_path):
+    lst, root = img_dir
+    # side features: dim 2, only for even instance ids
+    side = tmp_path / "side.txt"
+    side.write_text("2\n" + "".join(
+        f"{i} {i * 10.0} {i * 10.0 + 1}\n" for i in range(0, 6, 2)))
+    cfg = [
+        ("iter", "img"),
+        ("image_list", lst),
+        ("image_root", root),
+        ("input_shape", "3,32,32"),
+        ("batch_size", "3"),
+        ("silent", "1"),
+        ("iter", "attachtxt"),
+        ("filename", str(side)),
+        ("iter", "end"),
+    ]
+    it = create_iterator(cfg)
+    b = next(iter(it))
+    assert len(b.extra_data) == 1
+    assert b.extra_data[0].shape == (3, 1, 1, 2)
+    for row, inst in enumerate(b.inst_index):
+        want = [inst * 10.0, inst * 10.0 + 1] if inst % 2 == 0 else [0.0, 0.0]
+        assert b.extra_data[0][row, 0, 0].tolist() == want
+
+
+def test_recordio_shard_tail_no_hang(tmp_path):
+    """Regression: a shard whose byte range holds no record start must come
+    up empty quickly instead of spinning in _resync at EOF."""
+    path = str(tmp_path / "two.rec")
+    with RecordWriter(path) as w:
+        for i in range(2):
+            w.write(ImageRecord(inst_id=i, labels=np.zeros(1, np.float32),
+                                data=b"x" * 300).pack())
+    ids = []
+    for part in range(8):
+        ids += [ImageRecord.unpack(p).inst_id
+                for p in RecordReader(path, part, 8)]
+    assert sorted(ids) == [0, 1]
+
+
+def test_decode_image_grayscale():
+    from cxxnet_tpu.io.iter_imgrec import decode_image
+    data = _jpeg(_grad_img(24, 24))
+    a = decode_image(data, 1)
+    assert a.shape == (24, 24, 1)
+    a3 = decode_image(data, 3)
+    assert a3.shape == (24, 24, 3)
+
+
+def test_recordio_shard_no_duplicates(tmp_path):
+    """Regression: shard boundaries must not double-read a record whose
+    start lies just before the byte-range boundary (align-up, not down)."""
+    path = str(tmp_path / "many.rec")
+    with RecordWriter(path) as w:
+        for i in range(10):
+            w.write(ImageRecord(inst_id=i, labels=np.zeros(1, np.float32),
+                                data=b"y" * (90 + i)).pack())
+    for nsplit in (2, 3, 5, 7, 8, 13):
+        ids = []
+        for part in range(nsplit):
+            ids += [ImageRecord.unpack(p).inst_id
+                    for p in RecordReader(path, part, nsplit)]
+        assert sorted(ids) == list(range(10)), (nsplit, sorted(ids))

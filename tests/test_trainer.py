@@ -264,3 +264,45 @@ def test_round_batch_marks_padding():
     itr = create_iterator(parse_config_string(cfg_iter))
     last = list(itr)[-1]
     assert last.num_batch_padd == 64 * 8 - 500
+
+
+def test_extra_data_training(mesh8):
+    """extra_data input nodes (attachtxt path) feed the graph end to end:
+    the label is only predictable from the side feature, so learning proves
+    in_1 actually flows (reference nnet_config.h:229-252 extra-data nodes)."""
+    from cxxnet_tpu.io.data import DataBatch
+    cfg = parse_config_string("""
+extra_data_num = 1
+extra_data_shape[0] = 1,1,8
+netconfig=start
+layer[in,in_1->cat] = concat
+layer[cat->h1] = fullc:fc1
+  nhidden = 16
+  random_type = xavier
+layer[+1] = relu
+layer[+1] = fullc:fc2
+  nhidden = 4
+  random_type = xavier
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,4
+batch_size = 32
+eta = 0.3
+metric = error
+""")
+    tr = Trainer(cfg, mesh_ctx=mesh8)
+    tr.init_model()
+    rng = np.random.RandomState(0)
+    centers = rng.randn(4, 8).astype(np.float32) * 2
+    def make_batch():
+        lab = rng.randint(0, 4, size=32)
+        side = centers[lab] + 0.3 * rng.randn(32, 8).astype(np.float32)
+        return DataBatch(
+            data=rng.randn(32, 1, 1, 4).astype(np.float32),
+            label=lab[:, None].astype(np.float32),
+            extra_data=[side.reshape(32, 1, 1, 8)])
+    for _ in range(40):
+        tr.update(make_batch())
+    rep = tr.train_metric_report()
+    err = float(rep.split(":")[-1])
+    assert err < 0.2, rep
