@@ -1,0 +1,145 @@
+"""Python side of the C ABI (consumed by native/capi.cc).
+
+The C shim (cxxnet_tpu/native/capi.cc) embeds CPython and calls the
+functions here to implement the reference's C API surface
+(wrapper/cxxnet_wrapper.h:36-232). All array traffic crosses the boundary
+as (bytes, shape) pairs / read-only memoryviews, so the C side stays a
+thin marshalling layer with no numpy C API dependency.
+
+Layout convention at the ABI: data tensors are NCHW float32, matching the
+reference (cxxnet_wrapper.h CXNNetUpdateBatch docs); conversion to the
+framework's NHWC happens in wrapper.Net (layout='NCHW' default).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .wrapper import DataIter, Net
+
+__all__ = [
+    "io_create", "io_next", "io_before_first", "io_get_data", "io_get_label",
+    "net_create", "net_set_param", "net_init_model", "net_save_model",
+    "net_load_model", "net_start_round", "net_update_iter",
+    "net_update_batch", "net_predict_batch", "net_predict_iter",
+    "net_extract_batch", "net_extract_iter", "net_evaluate",
+    "net_get_weight", "net_set_weight",
+]
+
+
+def _arr(buf, shape) -> np.ndarray:
+    a = np.frombuffer(buf, dtype=np.float32)
+    return a.reshape(tuple(int(s) for s in shape))
+
+
+def _nchw_out(a: np.ndarray) -> Tuple[bytes, Tuple[int, int, int, int]]:
+    """Return a 4-D NCHW view of an (n,h,w,c) or (n,k) array as bytes."""
+    a = np.asarray(a, np.float32)
+    if a.ndim == 2:
+        a = a.reshape(a.shape[0], 1, 1, a.shape[1])
+    if a.ndim == 4:
+        a = np.transpose(a, (0, 3, 1, 2))
+    a = np.ascontiguousarray(a, np.float32)
+    return a.tobytes(), tuple(a.shape)
+
+
+# -- iterator handle ---------------------------------------------------------
+
+def io_create(cfg: str) -> DataIter:
+    return DataIter(cfg)
+
+
+def io_next(it: DataIter) -> int:
+    return 1 if it.next() else 0
+
+
+def io_before_first(it: DataIter) -> None:
+    it.before_first()
+
+
+def io_get_data(it: DataIter):
+    return _nchw_out(it.get_data())
+
+
+def io_get_label(it: DataIter):
+    lab = np.ascontiguousarray(it.get_label(), np.float32)
+    return lab.tobytes(), tuple(lab.shape)
+
+
+# -- net handle --------------------------------------------------------------
+
+def net_create(dev: str, cfg: str) -> Net:
+    return Net(dev=dev or "", cfg=cfg)
+
+
+def net_set_param(net: Net, name: str, val: str) -> None:
+    net.set_param(name, val)
+
+
+def net_init_model(net: Net) -> None:
+    net.init_model()
+
+
+def net_save_model(net: Net, fname: str) -> None:
+    net.save_model(fname)
+
+
+def net_load_model(net: Net, fname: str) -> None:
+    net.load_model(fname)
+
+
+def net_start_round(net: Net, r: int) -> None:
+    net.start_round(r)
+
+
+def net_update_iter(net: Net, it: DataIter) -> None:
+    net.update(it)
+
+
+def net_update_batch(net: Net, data, dshape, label, lshape) -> None:
+    net.update(_arr(data, dshape), _arr(label, lshape))
+
+
+def net_predict_batch(net: Net, data, dshape):
+    out = np.ascontiguousarray(net.predict(_arr(data, dshape)), np.float32)
+    return out.tobytes(), int(out.size)
+
+
+def net_predict_iter(net: Net, it: DataIter):
+    out = np.ascontiguousarray(net.predict(it), np.float32)
+    return out.tobytes(), int(out.size)
+
+
+def _extract_out(feat: np.ndarray):
+    # reference returns a 4-D shape for extract; ours is (n, k) -> (n,1,1,k)
+    return _nchw_out(feat)
+
+
+def net_extract_batch(net: Net, data, dshape, name: str):
+    return _extract_out(net.extract(_arr(data, dshape), name))
+
+
+def net_extract_iter(net: Net, it: DataIter, name: str):
+    return _extract_out(net.extract(it, name))
+
+
+def net_evaluate(net: Net, it: DataIter, name: str) -> str:
+    return net.evaluate(it, name)
+
+
+def net_get_weight(net: Net, layer: str, tag: str):
+    w = net.get_weight(layer, tag)
+    if w is None:
+        return None
+    w = np.ascontiguousarray(w, np.float32)
+    return w.tobytes(), tuple(w.shape), int(w.ndim)
+
+
+def net_set_weight(net: Net, data, size: int, layer: str, tag: str) -> None:
+    flat = np.frombuffer(data, dtype=np.float32, count=size)
+    cur = net.get_weight(layer, tag)
+    if cur is None:
+        raise KeyError(f"no weight {layer}:{tag}")
+    net.set_weight(flat.reshape(cur.shape), layer, tag)

@@ -110,7 +110,9 @@ class AugmentParams:
                 or self.max_aspect_ratio > 0
                 or self.min_crop_size > 0
                 or self.min_random_scale != 1.0
-                or self.max_random_scale != 1.0)
+                or self.max_random_scale != 1.0
+                or self.min_img_size > 0
+                or self.max_img_size < 1e10)
 
 
 def mean_cache_path(p: AugmentParams) -> str:

@@ -6,3 +6,13 @@ set -e
 cd "$(dirname "$0")"
 g++ -O3 -march=native -fPIC -shared -o libcxxnet_native.so decode.cc -ljpeg
 echo "built $(pwd)/libcxxnet_native.so"
+
+# C ABI (reference wrapper/cxxnet_wrapper.h analog): embeds CPython and
+# delegates to cxxnet_tpu.capi_bridge. Optional: skipped (without failing
+# the data-plane build above) when the CPython embed toolchain is missing.
+if EMBED_FLAGS=$(python3-config --includes --ldflags --embed 2>/dev/null); then
+  g++ -O3 -fPIC -shared -o libcxxnet_capi.so capi.cc ${EMBED_FLAGS}
+  echo "built $(pwd)/libcxxnet_capi.so"
+else
+  echo "skipped libcxxnet_capi.so (no python3-config --embed support)"
+fi

@@ -1,0 +1,326 @@
+// C ABI for the TPU-native framework, mirroring the reference C API
+// (/root/reference/wrapper/cxxnet_wrapper.h:36-232 — CXNIO* iterator
+// handles and CXNNet* net handles) so foreign-language hosts (C, MATLAB
+// MEX-style bindings, etc.) can drive training/inference.
+//
+// Implementation: embeds CPython and delegates to cxxnet_tpu.capi_bridge.
+// Works both from a non-Python host process (initializes the interpreter)
+// and when loaded inside an existing Python process via ctypes (reuses it;
+// every entry point takes the GIL). Array traffic crosses as read-only
+// memoryviews in, (bytes, shape) out; returned pointers stay valid until
+// the next call on any handle, matching the reference's "caller must copy
+// the result out before calling any other cxxnet function" contract.
+//
+// Build: cxxnet_tpu/native/build.sh  ->  libcxxnet_capi.so
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+PyObject* g_bridge = nullptr;          // cxxnet_tpu.capi_bridge module
+std::vector<char> g_buf;               // scratch for returned arrays
+std::string g_str;                     // scratch for returned strings
+
+class Gil {
+ public:
+  Gil() {
+    // First-use interpreter init must be raced-free when a non-Python host
+    // calls into the ABI from several threads at startup.
+    static std::once_flag once;
+    std::call_once(once, [] {
+      if (!Py_IsInitialized()) {
+        Py_InitializeEx(0);
+        // Drop the GIL acquired by initialization so PyGILState_Ensure
+        // below (and in future calls from any thread) behaves uniformly.
+        PyEval_SaveThread();
+      }
+    });
+    st_ = PyGILState_Ensure();
+  }
+  ~Gil() { PyGILState_Release(st_); }
+
+ private:
+  PyGILState_STATE st_;
+};
+
+PyObject* Bridge() {
+  if (g_bridge == nullptr) {
+    g_bridge = PyImport_ImportModule("cxxnet_tpu.capi_bridge");
+    if (g_bridge == nullptr) PyErr_Print();
+  }
+  return g_bridge;
+}
+
+// Call bridge.<fn>(args...); returns new reference or nullptr (error
+// printed to stderr, mirroring the reference's utils::Error abort-free
+// wrapper behavior as closely as a C ABI allows).
+PyObject* Call(const char* fn, PyObject* args) {
+  PyObject* mod = Bridge();
+  if (mod == nullptr) { Py_XDECREF(args); return nullptr; }
+  PyObject* f = PyObject_GetAttrString(mod, fn);
+  if (f == nullptr) { PyErr_Print(); Py_XDECREF(args); return nullptr; }
+  PyObject* out = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  if (out == nullptr) PyErr_Print();
+  return out;
+}
+
+PyObject* Mv(const float* p, uint64_t n_floats) {
+  return PyMemoryView_FromMemory(
+      reinterpret_cast<char*>(const_cast<float*>(p)),
+      static_cast<Py_ssize_t>(n_floats * sizeof(float)), PyBUF_READ);
+}
+
+PyObject* ShapeTuple(const unsigned* s, int n) {
+  PyObject* t = PyTuple_New(n);
+  for (int i = 0; i < n; ++i)
+    PyTuple_SET_ITEM(t, i, PyLong_FromUnsignedLong(s[i]));
+  return t;
+}
+
+uint64_t Prod(const unsigned* s, int n) {
+  uint64_t p = 1;
+  for (int i = 0; i < n; ++i) p *= s[i];
+  return p;
+}
+
+// Unpack a (bytes, shape[, ndim]) result into g_buf / oshape.
+const float* UnpackArray(PyObject* res, unsigned* oshape, int max_dim,
+                         unsigned* out_dim) {
+  if (res == nullptr || res == Py_None) { Py_XDECREF(res); return nullptr; }
+  PyObject* bytes = PyTuple_GetItem(res, 0);   // borrowed
+  PyObject* shape = PyTuple_GetItem(res, 1);
+  char* data; Py_ssize_t len;
+  PyBytes_AsStringAndSize(bytes, &data, &len);
+  g_buf.assign(data, data + len);
+  int nd = static_cast<int>(PyTuple_Size(shape));
+  for (int i = 0; i < max_dim; ++i)
+    oshape[i] = i < nd
+        ? static_cast<unsigned>(PyLong_AsUnsignedLong(PyTuple_GetItem(shape, i)))
+        : 1;
+  if (out_dim != nullptr) *out_dim = static_cast<unsigned>(nd);
+  Py_DECREF(res);
+  return reinterpret_cast<const float*>(g_buf.data());
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---- iterator handle -------------------------------------------------------
+
+void* CXNIOCreateFromConfig(const char* cfg) {
+  Gil g;
+  return Call("io_create", Py_BuildValue("(s)", cfg));
+}
+
+int CXNIONext(void* handle) {
+  Gil g;
+  PyObject* o = static_cast<PyObject*>(handle);
+  Py_INCREF(o);
+  PyObject* r = Call("io_next", PyTuple_Pack(1, o));
+  Py_DECREF(o);
+  if (r == nullptr) return 0;
+  int v = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return v;
+}
+
+void CXNIOBeforeFirst(void* handle) {
+  Gil g;
+  PyObject* o = static_cast<PyObject*>(handle);
+  Py_INCREF(o);
+  Py_XDECREF(Call("io_before_first", PyTuple_Pack(1, o)));
+  Py_DECREF(o);
+}
+
+const float* CXNIOGetData(void* handle, unsigned oshape[4],
+                          unsigned* ostride) {
+  Gil g;
+  PyObject* o = static_cast<PyObject*>(handle);
+  Py_INCREF(o);
+  PyObject* r = Call("io_get_data", PyTuple_Pack(1, o));
+  Py_DECREF(o);
+  const float* p = UnpackArray(r, oshape, 4, nullptr);
+  if (ostride != nullptr) *ostride = oshape[3];
+  return p;
+}
+
+const float* CXNIOGetLabel(void* handle, unsigned oshape[2],
+                           unsigned* ostride) {
+  Gil g;
+  PyObject* o = static_cast<PyObject*>(handle);
+  Py_INCREF(o);
+  PyObject* r = Call("io_get_label", PyTuple_Pack(1, o));
+  Py_DECREF(o);
+  const float* p = UnpackArray(r, oshape, 2, nullptr);
+  if (ostride != nullptr) *ostride = oshape[1];
+  return p;
+}
+
+void CXNIOFree(void* handle) {
+  Gil g;
+  Py_XDECREF(static_cast<PyObject*>(handle));
+}
+
+// ---- net handle ------------------------------------------------------------
+
+void* CXNNetCreate(const char* device, const char* cfg) {
+  Gil g;
+  return Call("net_create",
+              Py_BuildValue("(ss)", device == nullptr ? "" : device, cfg));
+}
+
+void CXNNetFree(void* handle) {
+  Gil g;
+  Py_XDECREF(static_cast<PyObject*>(handle));
+}
+
+void CXNNetSetParam(void* handle, const char* name, const char* val) {
+  Gil g;
+  PyObject* o = static_cast<PyObject*>(handle);
+  Py_XDECREF(Call("net_set_param", Py_BuildValue("(Oss)", o, name, val)));
+}
+
+void CXNNetInitModel(void* handle) {
+  Gil g;
+  PyObject* o = static_cast<PyObject*>(handle);
+  Py_INCREF(o);
+  Py_XDECREF(Call("net_init_model", PyTuple_Pack(1, o)));
+  Py_DECREF(o);
+}
+
+void CXNNetSaveModel(void* handle, const char* fname) {
+  Gil g;
+  PyObject* o = static_cast<PyObject*>(handle);
+  Py_XDECREF(Call("net_save_model", Py_BuildValue("(Os)", o, fname)));
+}
+
+void CXNNetLoadModel(void* handle, const char* fname) {
+  Gil g;
+  PyObject* o = static_cast<PyObject*>(handle);
+  Py_XDECREF(Call("net_load_model", Py_BuildValue("(Os)", o, fname)));
+}
+
+void CXNNetStartRound(void* handle, int round) {
+  Gil g;
+  PyObject* o = static_cast<PyObject*>(handle);
+  Py_XDECREF(Call("net_start_round", Py_BuildValue("(Oi)", o, round)));
+}
+
+void CXNNetUpdateIter(void* handle, void* data_handle) {
+  Gil g;
+  PyObject* o = static_cast<PyObject*>(handle);
+  PyObject* it = static_cast<PyObject*>(data_handle);
+  Py_XDECREF(Call("net_update_iter", Py_BuildValue("(OO)", o, it)));
+}
+
+void CXNNetUpdateBatch(void* handle, float* p_data, const unsigned dshape[4],
+                       float* p_label, const unsigned lshape[2]) {
+  Gil g;
+  PyObject* o = static_cast<PyObject*>(handle);
+  PyObject* args = Py_BuildValue(
+      "(ONONO)", o, Mv(p_data, Prod(dshape, 4)), ShapeTuple(dshape, 4),
+      Mv(p_label, Prod(lshape, 2)), ShapeTuple(lshape, 2));
+  Py_XDECREF(Call("net_update_batch", args));
+}
+
+const float* CXNNetPredictBatch(void* handle, float* p_data,
+                                const unsigned dshape[4],
+                                unsigned* out_size) {
+  Gil g;
+  PyObject* o = static_cast<PyObject*>(handle);
+  PyObject* args = Py_BuildValue(
+      "(ONO)", o, Mv(p_data, Prod(dshape, 4)), ShapeTuple(dshape, 4));
+  PyObject* r = Call("net_predict_batch", args);
+  if (r == nullptr) { *out_size = 0; return nullptr; }
+  char* data; Py_ssize_t len;
+  PyBytes_AsStringAndSize(PyTuple_GetItem(r, 0), &data, &len);
+  g_buf.assign(data, data + len);
+  *out_size = static_cast<unsigned>(
+      PyLong_AsUnsignedLong(PyTuple_GetItem(r, 1)));
+  Py_DECREF(r);
+  return reinterpret_cast<const float*>(g_buf.data());
+}
+
+const float* CXNNetPredictIter(void* handle, void* data_handle,
+                               unsigned* out_size) {
+  Gil g;
+  PyObject* o = static_cast<PyObject*>(handle);
+  PyObject* it = static_cast<PyObject*>(data_handle);
+  PyObject* r = Call("net_predict_iter", Py_BuildValue("(OO)", o, it));
+  if (r == nullptr) { *out_size = 0; return nullptr; }
+  char* data; Py_ssize_t len;
+  PyBytes_AsStringAndSize(PyTuple_GetItem(r, 0), &data, &len);
+  g_buf.assign(data, data + len);
+  *out_size = static_cast<unsigned>(
+      PyLong_AsUnsignedLong(PyTuple_GetItem(r, 1)));
+  Py_DECREF(r);
+  return reinterpret_cast<const float*>(g_buf.data());
+}
+
+const float* CXNNetExtractBatch(void* handle, float* p_data,
+                                const unsigned dshape[4],
+                                const char* node_name, unsigned oshape[4]) {
+  Gil g;
+  PyObject* o = static_cast<PyObject*>(handle);
+  PyObject* args = Py_BuildValue(
+      "(ONOs)", o, Mv(p_data, Prod(dshape, 4)), ShapeTuple(dshape, 4),
+      node_name);
+  return UnpackArray(Call("net_extract_batch", args), oshape, 4, nullptr);
+}
+
+const float* CXNNetExtractIter(void* handle, void* data_handle,
+                               const char* node_name, unsigned oshape[4]) {
+  Gil g;
+  PyObject* o = static_cast<PyObject*>(handle);
+  PyObject* it = static_cast<PyObject*>(data_handle);
+  PyObject* args = Py_BuildValue("(OOs)", o, it, node_name);
+  return UnpackArray(Call("net_extract_iter", args), oshape, 4, nullptr);
+}
+
+const char* CXNNetEvaluate(void* handle, void* data_handle,
+                           const char* data_name) {
+  Gil g;
+  PyObject* o = static_cast<PyObject*>(handle);
+  PyObject* it = static_cast<PyObject*>(data_handle);
+  PyObject* r = Call("net_evaluate", Py_BuildValue("(OOs)", o, it, data_name));
+  if (r == nullptr) return nullptr;
+  const char* s = PyUnicode_AsUTF8(r);
+  g_str = s == nullptr ? "" : s;
+  Py_DECREF(r);
+  return g_str.c_str();
+}
+
+const float* CXNNetGetWeight(void* handle, const char* layer_name,
+                             const char* wtag, unsigned wshape[4],
+                             unsigned* out_dim) {
+  Gil g;
+  PyObject* o = static_cast<PyObject*>(handle);
+  PyObject* r = Call("net_get_weight",
+                     Py_BuildValue("(Oss)", o, layer_name, wtag));
+  if (r == nullptr || r == Py_None) {
+    Py_XDECREF(r);
+    *out_dim = 0;
+    return nullptr;
+  }
+  return UnpackArray(r, wshape, 4, out_dim);
+}
+
+void CXNNetSetWeight(void* handle, float* p_weight, unsigned size_weight,
+                     const char* layer_name, const char* wtag) {
+  Gil g;
+  PyObject* o = static_cast<PyObject*>(handle);
+  PyObject* args = Py_BuildValue(
+      "(ONIss)", o, Mv(p_weight, size_weight), size_weight, layer_name, wtag);
+  Py_XDECREF(Call("net_set_weight", args));
+}
+
+}  // extern "C"

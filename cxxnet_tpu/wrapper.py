@@ -1,0 +1,268 @@
+"""High-level wrapper API: ``Net`` / ``DataIter`` / ``train``.
+
+Mirrors the reference Python binding surface (wrapper/cxxnet.py:67-314,
+itself a ctypes skin over the C API in wrapper/cxxnet_wrapper.h:36-232):
+
+* ``DataIter(cfg)`` — an iterator handle created from a config *string*
+  (CXNIOCreateFromConfig), with ``next()/before_first()/get_data()/
+  get_label()/check_valid()`` cursor semantics matching IIterator.
+* ``Net(dev, cfg)`` — a net handle (CXNNetCreate) with ``set_param``,
+  ``init_model``, ``save_model/load_model``, ``start_round``, ``update``
+  (from a DataIter *or* raw numpy arrays, CXNNetUpdateBatch),
+  ``evaluate``, ``predict``, ``extract``, ``get_weight``/``set_weight``.
+* ``train(cfg, data, label, num_round, param, eval_data)`` — the
+  convenience loop (wrapper/cxxnet.py:288-314).
+
+Layout note: the reference's raw-numpy entry points take NCHW float32
+(batch, channel, height, width — wrapper/cxxnet.py:165-167). This framework
+computes in NHWC (the TPU-friendly layout), so raw arrays are accepted in
+NCHW by default for drop-in compatibility and transposed on entry; pass
+``layout='NHWC'`` to skip the transpose. Flat 2-D ``(batch, features)``
+arrays are accepted directly.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from .config import ConfigPairs, parse_config_string
+from .io.data import DataBatch, create_iterator
+from .trainer import Trainer
+
+__all__ = ["DataIter", "Net", "train"]
+
+
+def _to_nhwc(data: np.ndarray, layout: str) -> np.ndarray:
+    """Accept (b,c,h,w) [reference convention], (b,h,w,c) or (b,k)."""
+    data = np.asarray(data, dtype=np.float32)
+    if data.ndim == 2:
+        return data.reshape(data.shape[0], 1, 1, data.shape[1])
+    if data.ndim != 4:
+        raise ValueError(
+            "need a 4-D (batch,channel,y,x) or 2-D (batch,features) array, "
+            f"got shape {data.shape}")
+    if layout.upper() == "NCHW":
+        return np.transpose(data, (0, 2, 3, 1))
+    if layout.upper() == "NHWC":
+        return data
+    raise ValueError(f"unknown layout {layout!r}")
+
+
+def _to_label(label: np.ndarray, batch: int) -> np.ndarray:
+    label = np.asarray(label, dtype=np.float32)
+    if label.ndim == 1:
+        label = label.reshape(-1, 1)
+    if label.ndim != 2 or label.shape[0] != batch:
+        raise ValueError(
+            f"label must be (batch,) or (batch,width); got {label.shape} "
+            f"for batch {batch}")
+    return label
+
+
+class DataIter:
+    """Iterator handle with the reference cursor protocol
+    (wrapper/cxxnet.py:70-106): ``next()`` advances and returns bool,
+    ``value`` is the current DataBatch, ``before_first()`` rewinds."""
+
+    def __init__(self, cfg: Union[str, ConfigPairs]):
+        pairs = parse_config_string(cfg) if isinstance(cfg, str) else list(cfg)
+        self._iter = create_iterator(pairs)
+        self.value: Optional[DataBatch] = None
+        self.head = True
+        self.tail = False
+
+    def next(self) -> bool:
+        if self.head:
+            self._iter.before_first()
+        self.head = False
+        self.value = self._iter.next()
+        self.tail = self.value is None
+        return not self.tail
+
+    def before_first(self) -> None:
+        self._iter.before_first()
+        self.value = None
+        self.head, self.tail = True, False
+
+    def check_valid(self) -> None:
+        if self.head:
+            raise RuntimeError(
+                "iterator at head state, call next() to get to valid state")
+        if self.tail:
+            raise RuntimeError("iterator reached end")
+
+    def get_data(self) -> np.ndarray:
+        self.check_valid()
+        return self.value.data
+
+    def get_label(self) -> np.ndarray:
+        self.check_valid()
+        return self.value.label
+
+    def __iter__(self):
+        # whole-epoch iteration (used by Net.evaluate / predict over an iter)
+        self.before_first()
+        while self.next():
+            yield self.value
+        self.before_first()
+
+
+class Net:
+    """Net handle (reference WrapperNet, wrapper/cxxnet_wrapper.cpp:79-257).
+
+    Config can be given at construction and/or via ``set_param`` before
+    ``init_model``; later ``set_param`` calls on schedule-style keys are
+    accepted but only affect a rebuilt net (matching the reference, where
+    SetParam after init only touches runtime knobs).
+    """
+
+    def __init__(self, dev: str = "", cfg: Union[str, ConfigPairs] = "",
+                 layout: str = "NCHW"):
+        self._cfg: List[Tuple[str, str]] = (
+            parse_config_string(cfg) if isinstance(cfg, str) else list(cfg))
+        if dev:
+            self._cfg.append(("dev", dev))
+        self._layout = layout
+        self._trainer: Optional[Trainer] = None
+
+    # -- config / lifecycle -------------------------------------------------
+    def set_param(self, name, value) -> None:
+        self._cfg.append((str(name), str(value)))
+
+    def _require(self) -> Trainer:
+        if self._trainer is None:
+            raise RuntimeError("call init_model() (or load_model) first")
+        return self._trainer
+
+    def _build(self) -> Trainer:
+        if self._trainer is None:
+            self._trainer = Trainer(self._cfg)
+        return self._trainer
+
+    def init_model(self) -> None:
+        self._build().init_model()
+
+    def load_model(self, fname: str) -> None:
+        # Trainer.load_model fully populates params/opt state from the
+        # checkpoint, so no (discarded) random init_model pass is needed.
+        self._build().load_model(fname)
+
+    def save_model(self, fname: str) -> None:
+        self._require().save_model(fname)
+
+    def copy_model_from(self, fname: str) -> None:
+        """Finetune-style name-matched weight copy (reference CopyModelFrom)."""
+        self._require().copy_model_from(fname)
+
+    def start_round(self, round_counter: int) -> None:
+        self._require().start_round(round_counter)
+
+    # -- data plumbing ------------------------------------------------------
+    def _as_batch(self, data, label=None) -> DataBatch:
+        if isinstance(data, DataBatch):
+            return data
+        arr = _to_nhwc(data, self._layout)
+        if label is None:
+            lab = np.zeros((arr.shape[0], 1), np.float32)
+        else:
+            lab = _to_label(label, arr.shape[0])
+        return DataBatch(data=arr, label=lab)
+
+    # -- training / inference ----------------------------------------------
+    def update(self, data, label=None) -> None:
+        """One update step from a DataIter's current batch or raw arrays
+        (reference CXNNetUpdateIter / CXNNetUpdateBatch)."""
+        tr = self._require()
+        if isinstance(data, DataIter):
+            data.check_valid()
+            tr.update(data.value)
+        else:
+            if label is None and not isinstance(data, DataBatch):
+                raise ValueError("need label to update from a raw array")
+            tr.update(self._as_batch(data, label))
+
+    def evaluate(self, data, name: str) -> str:
+        """Evaluate over a full iterator; returns the reference's
+        ``\\tname-metric:value`` log fragment."""
+        tr = self._require()
+        if isinstance(data, DataIter):
+            return tr.evaluate(iter(data), name)
+        return tr.evaluate(data, name)
+
+    def predict(self, data, label=None) -> np.ndarray:
+        """Prediction (argmax class / raw scalar). DataIter → current batch,
+        matching CXNNetPredictIter; ndarray → that batch."""
+        tr = self._require()
+        if isinstance(data, DataIter):
+            data.check_valid()
+            return tr.predict(data.value)
+        return tr.predict(self._as_batch(data, label))
+
+    def predict_raw(self, data) -> np.ndarray:
+        tr = self._require()
+        if isinstance(data, DataIter):
+            data.check_valid()
+            return tr.predict_raw(data.value)
+        return tr.predict_raw(self._as_batch(data))
+
+    def extract(self, data, name: str) -> np.ndarray:
+        """Extract a named node's activations ('top' = last node)."""
+        tr = self._require()
+        if isinstance(data, DataIter):
+            data.check_valid()
+            return tr.extract_feature(data.value, name)
+        return tr.extract_feature(self._as_batch(data), name)
+
+    # -- weights ------------------------------------------------------------
+    def get_weight(self, layer_name: str, tag: str = "wmat"):
+        tr = self._require()
+        try:
+            return tr.get_weight(layer_name, tag)
+        except KeyError:
+            return None     # reference returns NULL/odim=0 for missing
+
+    def set_weight(self, weight: np.ndarray, layer_name: str,
+                   tag: str = "wmat") -> None:
+        self._require().set_weight(np.asarray(weight, np.float32),
+                                   layer_name, tag)
+
+    @property
+    def trainer(self) -> Trainer:
+        """Escape hatch to the full Trainer API."""
+        return self._require()
+
+
+def train(cfg: Union[str, ConfigPairs], data, label=None, num_round: int = 1,
+          param: Union[Dict, Sequence[Tuple[str, str]], None] = None,
+          eval_data: Optional[DataIter] = None, print_step: int = 100,
+          silent: bool = False) -> Net:
+    """Convenience training loop (reference wrapper/cxxnet.py:288-314)."""
+    net = Net(cfg=cfg)
+    if param:
+        items = param.items() if isinstance(param, dict) else param
+        for k, v in items:
+            net.set_param(k, v)
+    net.init_model()
+    if isinstance(data, DataIter):
+        for r in range(num_round):
+            net.start_round(r)
+            data.before_first()
+            scounter = 0
+            while data.next():
+                net.update(data)
+                scounter += 1
+                if scounter % print_step == 0 and not silent:
+                    print(f"[{r}] {scounter} batch passed")
+            line = net.trainer.train_metric_report("train") \
+                if net.trainer.eval_train else ""
+            if eval_data is not None:
+                line += net.evaluate(eval_data, "eval")
+            if not silent and line:
+                print(f"round {r}{line}")
+    else:
+        for r in range(num_round):
+            net.start_round(r)
+            net.update(data=data, label=label)
+    return net
