@@ -38,6 +38,9 @@ KNOWN_LAYER_TYPES = {
     "maxout", "split", "insanity", "rrelu", "insanity_max_pooling",
     "lp_loss", "l2_loss", "multi_logistic", "ch_concat", "prelu",
     "batch_norm", "batch_norm_no_ma",
+    # sequence/transformer extensions (no reference analog; SURVEY §5
+    # long-context is N/A there — first-class here)
+    "embed", "layernorm", "mha", "ffn", "seqfc", "add", "lmloss", "moe",
 }
 
 

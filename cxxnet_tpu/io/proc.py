@@ -177,8 +177,31 @@ class CSVIterator(DataIter):
                          inst_index=idx.astype(np.int64))
 
 
+class _InMemoryIterator(DataIter):
+    """Shared sequential batch cursor over in-memory ``self.data`` /
+    ``self.labels`` arrays with tail-padding (num_batch_padd); subclasses
+    implement ``init()`` to fill the arrays."""
+
+    def before_first(self):
+        self._pos = 0
+
+    def next(self):
+        n = self.data.shape[0]
+        if self._pos >= n:
+            return None
+        bs = self.batch_size
+        idx = np.arange(self._pos, min(self._pos + bs, n))
+        padd = 0
+        if len(idx) < bs:
+            padd = bs - len(idx)
+            idx = np.concatenate([idx, np.repeat(idx[-1:], padd)])
+        self._pos += bs
+        return DataBatch(data=self.data[idx], label=self.labels[idx],
+                         num_batch_padd=padd, inst_index=idx.astype(np.int64))
+
+
 @register_iter("synthetic")
-class SyntheticIterator(DataIter):
+class SyntheticIterator(_InMemoryIterator):
     """Deterministic gaussian-cluster classification data for tests and IO-free
     benchmarking (plays the role of the reference's test_io/test_skipread
     harness, iter_batch_proc-inl.hpp:21,69)."""
@@ -222,18 +245,48 @@ class SyntheticIterator(DataIter):
                               (1, self.label_width))
         self.before_first()
 
-    def before_first(self):
-        self._pos = 0
 
-    def next(self):
-        if self._pos >= self.num_inst:
-            return None
-        bs = self.batch_size
-        idx = np.arange(self._pos, min(self._pos + bs, self.num_inst))
-        padd = 0
-        if len(idx) < bs:
-            padd = bs - len(idx)
-            idx = np.concatenate([idx, np.repeat(idx[-1:], padd)])
-        self._pos += bs
-        return DataBatch(data=self.data[idx], label=self.labels[idx],
-                         num_batch_padd=padd, inst_index=idx.astype(np.int64))
+@register_iter("synthetic_lm")
+class SyntheticLMIterator(_InMemoryIterator):
+    """Deterministic token-sequence data for language-model tests: labels are
+    ``(token_t + token_0) mod vocab_size`` — solvable only by attending back
+    to position 0, so it exercises attention, not just the FFN. Extension
+    iterator (the reference has no sequence data)."""
+
+    def set_param(self, name, val):
+        if name == "num_inst":
+            self.num_inst = int(val)
+        elif name == "batch_size":
+            self.batch_size = int(val)
+        elif name == "vocab_size":
+            self.vocab_size = int(val)
+        elif name == "seq_len":
+            self.seq_len = int(val)
+        elif name == "seed_data":
+            self.seed = int(val)
+        elif name == "lm_task":
+            if val not in ("add0", "copy"):
+                raise ValueError(f"unknown lm_task {val!r}")
+            self.lm_task = val
+
+    def __init__(self, cfg):
+        self.num_inst = 512
+        self.batch_size = 32
+        self.vocab_size = 32
+        self.seq_len = 64
+        self.seed = 11
+        self.lm_task = "add0"
+        super().__init__(cfg)
+
+    def init(self):
+        rng = np.random.RandomState(self.seed)
+        toks = rng.randint(0, self.vocab_size,
+                           size=(self.num_inst, self.seq_len))
+        if self.lm_task == "copy":      # fast-learnable (no attention needed)
+            lab = toks
+        else:                           # requires attending to position 0
+            lab = (toks + toks[:, :1]) % self.vocab_size
+        self.data = toks.astype(np.float32) \
+            .reshape(self.num_inst, 1, 1, self.seq_len)
+        self.labels = lab.astype(np.float32)
+        self.before_first()

@@ -15,7 +15,7 @@ import jax.numpy as jnp
 from ..config import ConfigPairs
 from ..graph import LayerSpec
 from .base import LAYER_REGISTRY, ApplyCtx, Layer, Shape3, register_layer
-from . import core, conv, norm, loss  # noqa: F401  (populate registry)
+from . import core, conv, norm, loss, seq, moe  # noqa: F401  (populate registry)
 
 
 class PairTestLayer(Layer):

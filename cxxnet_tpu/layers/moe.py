@@ -1,0 +1,144 @@
+"""Mixture-of-Experts layer (expert parallelism).
+
+TPU-idiomatic extension beyond the reference (no MoE exists there; the
+closest spirit is fullc_gather's hybrid data/model parallelism,
+/root/reference/src/updater/async_updater-inl.hpp:68-94): a token-choice
+top-k routed expert FFN in the GShard/Switch formulation — dense dispatch/
+combine one-hot tensors with a fixed per-expert capacity so every shape is
+static for XLA. Expert weights carry a leading expert axis sharded over the
+mesh 'model' axis; under pjit, GSPMD lowers the dispatch/combine einsums to
+the expert all-to-all over ICI.
+
+Config (sequence node (E,S,1) -> (E,S,1)):
+  ``num_expert``, ``topk`` (1 or 2), ``nhidden`` (expert inner dim),
+  ``capacity_factor`` (default 1.25), ``act`` (gelu/relu),
+  ``moe_loss_coef`` (load-balance aux loss weight, default 0.01).
+
+The load-balancing auxiliary loss (mean fraction-routed * mean gate prob
+per expert, scaled by num_expert) rides the layer state under
+``_aux_loss`` and is added to the training objective by Network.apply.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import Layer, register_layer
+from .seq import _seq, _unseq
+
+
+@register_layer("moe")
+class MoELayer(Layer):
+    has_params = True
+    has_state = True
+
+    def set_param(self, name, val):
+        if name == "num_expert":
+            self.num_expert = int(val)
+        elif name == "topk":
+            self.topk = int(val)
+        elif name == "capacity_factor":
+            self.capacity_factor = float(val)
+        elif name == "act":
+            if val not in ("gelu", "relu"):
+                raise ValueError(f"unknown moe act {val!r}")
+            self.act = val
+        elif name == "moe_loss_coef":
+            self.moe_loss_coef = float(val)
+
+    def __init__(self, spec, global_cfg):
+        self.num_expert = 8
+        self.topk = 2
+        self.capacity_factor = 1.25
+        self.act = "gelu"
+        self.moe_loss_coef = 0.01
+        super().__init__(spec, global_cfg)
+        if self.topk not in (1, 2):
+            raise ValueError("moe: topk must be 1 or 2")
+
+    def infer_shapes(self, in_shapes):
+        self.check_n(in_shapes, 1, 1)
+        return [in_shapes[0]]
+
+    def init_params(self, key, in_shapes):
+        e = in_shapes[0][0]
+        f = self.hp.num_hidden or 4 * e
+        x = self.num_expert
+        kr, k1, k2 = jax.random.split(key, 3)
+        return {
+            "router": {"wmat": self.hp.init_weight(kr, (e, x), e, x)},
+            "h": {"wmat": self.hp.init_weight(k1, (x, e, f), e, f),
+                  "bias": jnp.zeros((x, f), jnp.float32)},
+            "o": {"wmat": self.hp.init_weight(k2, (x, f, e), f, e),
+                  "bias": jnp.zeros((x, e), jnp.float32)},
+        }
+
+    def param_pspecs(self):
+        # experts sharded over 'model' (expert parallelism); router replicated
+        return {"h": {"wmat": ("model", None, None), "bias": ("model", None)},
+                "o": {"wmat": ("model", None, None), "bias": ("model", None)}}
+
+    def init_state(self, in_shapes):
+        return {"_aux_loss": jnp.zeros((), jnp.float32)}
+
+    def apply(self, params, state, inputs, ctx):
+        x = _seq(inputs[0]).astype(ctx.compute_dtype)   # (B, T, E)
+        B, T, E = x.shape
+        X = self.num_expert
+        C = max(1, int(T / X * self.capacity_factor * self.topk))
+
+        logits = jnp.einsum("bte,ex->btx", x.astype(jnp.float32),
+                            params["router"]["wmat"].astype(jnp.float32))
+        gates = jax.nn.softmax(logits, axis=-1)          # (B, T, X)
+
+        # top-1 (+ optional top-2) token-choice routing with capacity
+        def one_hot_dispatch(gate_residual):
+            idx = jnp.argmax(gate_residual, axis=-1)     # (B, T)
+            oh = jax.nn.one_hot(idx, X, dtype=jnp.float32)
+            return idx, oh
+
+        idx1, oh1 = one_hot_dispatch(gates)
+        sel = [(oh1, jnp.take_along_axis(gates, idx1[..., None],
+                                         axis=-1)[..., 0])]
+        if self.topk == 2:
+            idx2, oh2 = one_hot_dispatch(gates - gates * oh1 - oh1)
+            sel.append((oh2, jnp.take_along_axis(gates, idx2[..., None],
+                                                 axis=-1)[..., 0]))
+
+        # position-in-expert via cumulative sum over tokens; tokens past the
+        # capacity C are dropped (standard Switch behavior, keeps shapes
+        # static for XLA)
+        dispatch = jnp.zeros((B, T, X, C), jnp.float32)
+        combine = jnp.zeros((B, T, X, C), jnp.float32)
+        prev_count = jnp.zeros((B, X), jnp.float32)
+        for oh, gate in sel:
+            pos = jnp.cumsum(oh, axis=1) - oh + prev_count[:, None, :]
+            prev_count = prev_count + jnp.sum(oh, axis=1)
+            pos_in = jnp.sum(pos * oh, axis=-1)          # (B, T)
+            keep = (pos_in < C).astype(jnp.float32) * jnp.sum(oh, axis=-1)
+            slot = jax.nn.one_hot(pos_in.astype(jnp.int32), C,
+                                  dtype=jnp.float32)     # (B, T, C)
+            d = oh[..., None] * slot[:, :, None, :] * keep[..., None, None]
+            dispatch = dispatch + d
+            combine = combine + d * gate[..., None, None]
+
+        # dispatch -> per-expert capacity buffers, expert FFN, combine back
+        ex_in = jnp.einsum("btxc,bte->bxce", dispatch,
+                           x.astype(jnp.float32)).astype(ctx.compute_dtype)
+        h = jnp.einsum("bxce,xef->bxcf", ex_in,
+                       params["h"]["wmat"].astype(ctx.compute_dtype))
+        h = h + params["h"]["bias"].astype(ctx.compute_dtype)[None, :, None, :]
+        h = jax.nn.gelu(h) if self.act == "gelu" else jax.nn.relu(h)
+        y = jnp.einsum("bxcf,xfe->bxce", h,
+                       params["o"]["wmat"].astype(ctx.compute_dtype))
+        y = y + params["o"]["bias"].astype(ctx.compute_dtype)[None, :, None, :]
+        out = jnp.einsum("btxc,bxce->bte", combine,
+                         y.astype(jnp.float32)).astype(ctx.compute_dtype)
+
+        # load-balance aux loss (GShard eq.4): X * mean_x(frac_tokens_x *
+        # mean_gate_x)
+        frac = jnp.mean(oh1, axis=(0, 1))                # (X,)
+        mean_gate = jnp.mean(gates, axis=(0, 1))
+        aux = self.moe_loss_coef * X * jnp.sum(frac * mean_gate)
+        return [_unseq(out)], {"_aux_loss": aux}

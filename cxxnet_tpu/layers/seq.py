@@ -1,0 +1,338 @@
+"""Sequence / transformer layers: embed, layernorm, mha, ffn, seqfc, add,
+lmloss.
+
+TPU-idiomatic extension beyond the reference (which has no sequence axis —
+fixed image tensors, /root/reference/src/layer/layer.h:33-39; SURVEY §5
+"long-context: N/A"): these layers make attention models expressible in the
+same config dialect, with tensor-parallel PartitionSpecs over the mesh
+'model' axis (heads for attention, hidden for the FFN) and attention
+implementations from cxxnet_tpu.ops (reference / chunked online-softmax /
+Pallas flash). Ring-attention sequence parallelism over a 'seq' axis lives
+in cxxnet_tpu.parallel.ring and shares the same math.
+
+Node convention for sequences: logical shape3 ``(E, S, 1)`` -> array
+``(batch, S, 1, E)`` (tokens on the y axis, features on the channel axis,
+consistent with the framework's NHWC image convention). Token-id inputs are
+flat nodes ``(1, 1, S)``.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import (attention_reference, chunked_attention,
+                             flash_attention)
+from .base import Layer, Shape3, register_layer
+from .loss import LossLayerBase
+
+
+def _seq(x: jax.Array) -> jax.Array:
+    """(b, S, 1, E) -> (b, S, E)."""
+    return x.reshape(x.shape[0], x.shape[1], x.shape[3])
+
+
+def _unseq(x: jax.Array) -> jax.Array:
+    """(b, S, E) -> (b, S, 1, E)."""
+    return x.reshape(x.shape[0], x.shape[1], 1, x.shape[2])
+
+
+@register_layer("embed")
+class EmbedLayer(Layer):
+    """Token embedding: flat id node (1,1,S) -> sequence node (E,S,1).
+    ``nhidden`` = embedding dim, ``vocab_size`` = table rows."""
+    has_params = True
+
+    def set_param(self, name, val):
+        if name == "vocab_size":
+            self.vocab_size = int(val)
+
+    def __init__(self, spec, global_cfg):
+        self.vocab_size = 0
+        super().__init__(spec, global_cfg)
+        if self.vocab_size <= 0:
+            raise ValueError(f"embed layer {spec.name!r} needs vocab_size")
+
+    def infer_shapes(self, in_shapes: List[Shape3]) -> List[Shape3]:
+        self.check_n(in_shapes, 1, 1)
+        c, y, S = in_shapes[0]
+        if c != 1 or y != 1:
+            raise ValueError("embed expects a flat (1,1,S) token-id node")
+        return [(self.hp.num_hidden, S, 1)]
+
+    def init_params(self, key, in_shapes):
+        return {"wmat": self.hp.init_weight(
+            key, (self.vocab_size, self.hp.num_hidden),
+            self.vocab_size, self.hp.num_hidden)}
+
+    def apply(self, params, state, inputs, ctx):
+        x = inputs[0]
+        ids = x.reshape(x.shape[0], -1).astype(jnp.int32)
+        out = jnp.take(params["wmat"].astype(ctx.compute_dtype), ids, axis=0)
+        return [_unseq(out)], state
+
+
+@register_layer("layernorm")
+class LayerNormLayer(Layer):
+    """LayerNorm over the feature axis of a sequence node. Params are keyed
+    gamma/beta, which the optimizer scopes into the 'bias' hyper group (so
+    weight decay does not pull the multiplicative gamma toward 0)."""
+    has_params = True
+
+    def set_param(self, name, val):
+        if name == "eps":
+            self.eps = float(val)
+
+    def __init__(self, spec, global_cfg):
+        self.eps = 1e-5
+        super().__init__(spec, global_cfg)
+
+    def infer_shapes(self, in_shapes):
+        self.check_n(in_shapes, 1, 1)
+        return [in_shapes[0]]
+
+    def init_params(self, key, in_shapes):
+        e = in_shapes[0][0]
+        return {"gamma": jnp.ones((e,), jnp.float32),
+                "beta": jnp.zeros((e,), jnp.float32)}
+
+    def apply(self, params, state, inputs, ctx):
+        x = inputs[0].astype(jnp.float32)
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+        y = (x - mu) * jax.lax.rsqrt(var + self.eps)
+        y = y * params["gamma"] + params["beta"]
+        return [y.astype(ctx.compute_dtype)], state
+
+
+class _SeqLinearMixin:
+    """Shared init for (in_dim -> out_dim) projections on sequence nodes."""
+
+    def _linear_params(self, key, in_dim, out_dim, no_bias):
+        p = {"wmat": self.hp.init_weight(key, (in_dim, out_dim),
+                                         in_dim, out_dim)}
+        if not no_bias:
+            p["bias"] = jnp.full((out_dim,), self.hp.init_bias, jnp.float32)
+        return p
+
+
+@register_layer("mha")
+class MultiHeadAttentionLayer(Layer, _SeqLinearMixin):
+    """Multi-head self-attention on a sequence node (E,S,1) -> (E,S,1).
+
+    Config: ``nhead``, ``causal`` (0/1), ``attn_impl`` in
+    {auto, ref, chunked, flash}, ``attn_block`` (flash/chunked block size).
+    Tensor parallelism: q/k/v projections shard over heads on the mesh
+    'model' axis, the output projection contracts over sharded heads — the
+    TPU-native generalization of the reference's fullc_gather hybrid
+    (/root/reference/src/updater/async_updater-inl.hpp:68-94).
+    """
+    has_params = True
+
+    def set_param(self, name, val):
+        if name == "nhead":
+            self.nhead = int(val)
+        elif name == "causal":
+            self.causal = bool(int(val))
+        elif name == "attn_impl":
+            if val not in ("auto", "ref", "chunked", "flash"):
+                raise ValueError(f"unknown attn_impl {val!r}")
+            self.attn_impl = val
+        elif name == "attn_block":
+            self.attn_block = int(val)
+
+    def __init__(self, spec, global_cfg):
+        self.nhead = 8
+        self.causal = False
+        self.attn_impl = "auto"
+        self.attn_block = 128
+        super().__init__(spec, global_cfg)
+
+    def infer_shapes(self, in_shapes):
+        self.check_n(in_shapes, 1, 1)
+        e, s, _ = in_shapes[0]
+        if e % self.nhead:
+            raise ValueError(
+                f"mha {self.name!r}: dim {e} not divisible by nhead {self.nhead}")
+        return [in_shapes[0]]
+
+    def init_params(self, key, in_shapes):
+        e = in_shapes[0][0]
+        h, d = self.nhead, e // self.nhead
+        ks = jax.random.split(key, 4)
+        p = {}
+        for i, nm in enumerate(("q", "k", "v")):
+            sub = self._linear_params(ks[i], e, e, self.hp.no_bias)
+            sub["wmat"] = sub["wmat"].reshape(e, h, d)
+            if "bias" in sub:
+                sub["bias"] = sub["bias"].reshape(h, d)
+            p[nm] = sub
+        out = self._linear_params(ks[3], e, e, self.hp.no_bias)
+        out["wmat"] = out["wmat"].reshape(h, d, e)
+        p["o"] = out
+        return p
+
+    def param_pspecs(self):
+        qkv = {"wmat": (None, "model", None), "bias": ("model", None)}
+        return {"q": qkv, "k": qkv, "v": qkv,
+                "o": {"wmat": ("model", None, None), "bias": None}}
+
+    def _attend(self, q, k, v, ctx):
+        if self.attn_impl == "ref":
+            return attention_reference(q, k, v, causal=self.causal)
+        if self.attn_impl == "chunked":
+            return chunked_attention(q, k, v, causal=self.causal,
+                                     block_k=self.attn_block)
+        if self.attn_impl == "flash":
+            return flash_attention(q, k, v, causal=self.causal,
+                                   block_q=self.attn_block,
+                                   block_k=self.attn_block)
+        # auto: flash on TPU when the sequence tiles evenly, plain reference
+        # for short sequences, chunked otherwise
+        S = q.shape[1]
+        if jax.default_backend() == "tpu" and S % self.attn_block == 0:
+            return flash_attention(q, k, v, causal=self.causal,
+                                   block_q=self.attn_block,
+                                   block_k=self.attn_block)
+        if S <= 512:
+            return attention_reference(q, k, v, causal=self.causal)
+        return chunked_attention(q, k, v, causal=self.causal,
+                                 block_k=self.attn_block)
+
+    def apply(self, params, state, inputs, ctx):
+        x = _seq(inputs[0]).astype(ctx.compute_dtype)
+
+        def proj(nm):
+            w = params[nm]["wmat"].astype(ctx.compute_dtype)
+            out = jnp.einsum("bse,ehd->bshd", x, w)
+            if "bias" in params[nm]:
+                out = out + params[nm]["bias"].astype(ctx.compute_dtype)
+            return out
+
+        q, k, v = proj("q"), proj("k"), proj("v")
+        o = self._attend(q, k, v, ctx)
+        wo = params["o"]["wmat"].astype(ctx.compute_dtype)
+        y = jnp.einsum("bshd,hde->bse", o, wo)
+        if "bias" in params["o"]:
+            y = y + params["o"]["bias"].astype(ctx.compute_dtype)
+        return [_unseq(y)], state
+
+
+@register_layer("ffn")
+class FFNLayer(Layer, _SeqLinearMixin):
+    """Position-wise feed-forward (E,S,1) -> (E,S,1); ``nhidden`` = inner
+    dim, ``act`` in {gelu, relu}. TP: inner dim sharded over 'model'."""
+    has_params = True
+
+    def set_param(self, name, val):
+        if name == "act":
+            if val not in ("gelu", "relu"):
+                raise ValueError(f"unknown ffn act {val!r}")
+            self.act = val
+
+    def __init__(self, spec, global_cfg):
+        self.act = "gelu"
+        super().__init__(spec, global_cfg)
+
+    def infer_shapes(self, in_shapes):
+        self.check_n(in_shapes, 1, 1)
+        return [in_shapes[0]]
+
+    def init_params(self, key, in_shapes):
+        e = in_shapes[0][0]
+        f = self.hp.num_hidden or 4 * e
+        k1, k2 = jax.random.split(key)
+        return {"h": self._linear_params(k1, e, f, self.hp.no_bias),
+                "o": self._linear_params(k2, f, e, self.hp.no_bias)}
+
+    def param_pspecs(self):
+        return {"h": {"wmat": (None, "model"), "bias": ("model",)},
+                "o": {"wmat": ("model", None), "bias": None}}
+
+    def apply(self, params, state, inputs, ctx):
+        x = _seq(inputs[0]).astype(ctx.compute_dtype)
+        h = jnp.einsum("bse,ef->bsf", x,
+                       params["h"]["wmat"].astype(ctx.compute_dtype))
+        if "bias" in params["h"]:
+            h = h + params["h"]["bias"].astype(ctx.compute_dtype)
+        h = jax.nn.gelu(h) if self.act == "gelu" else jax.nn.relu(h)
+        y = jnp.einsum("bsf,fe->bse", h,
+                       params["o"]["wmat"].astype(ctx.compute_dtype))
+        if "bias" in params["o"]:
+            y = y + params["o"]["bias"].astype(ctx.compute_dtype)
+        return [_unseq(y)], state
+
+
+@register_layer("seqfc")
+class SeqFCLayer(Layer, _SeqLinearMixin):
+    """Per-position linear projection (E,S,1) -> (K,S,1), e.g. the LM head.
+    ``nhidden`` = K."""
+    has_params = True
+
+    def infer_shapes(self, in_shapes):
+        self.check_n(in_shapes, 1, 1)
+        e, s, _ = in_shapes[0]
+        return [(self.hp.num_hidden, s, 1)]
+
+    def init_params(self, key, in_shapes):
+        e = in_shapes[0][0]
+        return self._linear_params(key, e, self.hp.num_hidden, self.hp.no_bias)
+
+    def param_pspecs(self):
+        return {"wmat": (None, "model"), "bias": ("model",)}
+
+    def apply(self, params, state, inputs, ctx):
+        x = _seq(inputs[0]).astype(ctx.compute_dtype)
+        y = jnp.einsum("bse,ek->bsk", x,
+                       params["wmat"].astype(ctx.compute_dtype))
+        if "bias" in params:
+            y = y + params["bias"].astype(ctx.compute_dtype)
+        return [_unseq(y)], state
+
+
+@register_layer("add")
+class AddLayer(Layer):
+    """Elementwise sum of N same-shape nodes (residual connections).
+    The DAG dialect already allows one node to feed several layers (the
+    functional executor has no buffer aliasing), so x + f(x) is
+    ``layer[x,fx->y] = add``."""
+
+    def infer_shapes(self, in_shapes):
+        if len(in_shapes) < 2 or len(self.spec.nindex_out) != 1:
+            raise ValueError(f"add layer {self.name!r} needs >=2 inputs, 1 output")
+        for s in in_shapes[1:]:
+            if s != in_shapes[0]:
+                raise ValueError(
+                    f"add layer {self.name!r}: shape mismatch {in_shapes}")
+        return [in_shapes[0]]
+
+    def apply(self, params, state, inputs, ctx):
+        out = inputs[0]
+        for x in inputs[1:]:
+            out = out + x
+        return [out], state
+
+
+@register_layer("lmloss")
+class LMLossLayer(LossLayerBase):
+    """Per-token softmax cross-entropy for language modeling: logits node
+    (V,S,1) vs a label slice of width S (token ids). Forward emits per-token
+    **log**-probabilities (log_softmax: numerically exact where probs would
+    underflow f32, so confidently-wrong tokens keep their gradient; argmax
+    metrics are unaffected); loss = masked mean NLL over all tokens."""
+
+    def apply(self, params, state, inputs, ctx):
+        x = inputs[0]                              # (b, S, 1, V)
+        logits = x.astype(jnp.float32)
+        return [jax.nn.log_softmax(logits, axis=-1)], state
+
+    def loss(self, outputs, label, mask):
+        logp_all = outputs[0]                      # (b, S, 1, V) log-probs
+        b, S = logp_all.shape[0], logp_all.shape[1]
+        lp2 = logp_all.reshape(b, S, -1)
+        idx = label.astype(jnp.int32)              # (b, S)
+        logp = jnp.take_along_axis(lp2, idx[:, :, None], axis=2)[:, :, 0]
+        per_example = -jnp.mean(logp, axis=1)      # mean over tokens
+        return self._mean(per_example, mask)

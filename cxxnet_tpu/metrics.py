@@ -103,7 +103,23 @@ class MetricRecall(Metric):
         self.cnt += n
 
 
+class MetricSeqError(Metric):
+    """Per-token classification error for sequence models: pred is the
+    flattened (n, S*V) per-token probabilities, label is (n, S) token ids
+    (V inferred as pred_cols // label_cols). Extension metric — the
+    reference has no sequence axis."""
+
+    def add(self, pred, label):
+        n, S = label.shape
+        V = pred.shape[1] // S
+        guess = np.argmax(pred.reshape(n, S, V), axis=2)
+        self.sum += float(np.sum(guess != label.astype(np.int64)))
+        self.cnt += n * S
+
+
 def create_metric(name: str, label_field: str) -> Metric:
+    if name == "seq_error":
+        return MetricSeqError(name, label_field)
     if name == "rmse":
         return MetricRMSE(name, label_field)
     if name == "error":

@@ -132,6 +132,11 @@ class Network:
             outputs, lstate_out = layer.apply(lparams, lstate, inputs, ctx)
             if lstate_out:
                 new_state[layer.name] = lstate_out
+                # auxiliary regularizers (e.g. MoE load-balancing loss)
+                # ride the state dict under "_aux_loss" and only count
+                # during training
+                if train and "_aux_loss" in lstate_out:
+                    total_loss = total_loss + lstate_out["_aux_loss"]
             for ni, out in zip(spec.nindex_out, outputs):
                 nodes[ni] = out
             if layer.is_loss and label is not None:
@@ -168,9 +173,9 @@ class Network:
 
     # -- introspection -----------------------------------------------------
     def param_tag(self, layer_name: str, param_name: str) -> str:
-        """Tag used for lr/wd scoping: 'wmat' or 'bias'
-        (reference updater key encoding, updater.h:150-173)."""
-        return "bias" if param_name == "bias" else "wmat"
+        """Tag used for lr/wd scoping: 'wmat' or 'bias'."""
+        from .optim import tag_for_param
+        return tag_for_param(param_name)
 
     def out_shape(self) -> Shape3:
         return self.node_shapes[self.graph.layers[-1].nindex_out[0]]

@@ -94,7 +94,14 @@ uint64_t Prod(const unsigned* s, int n) {
 // Unpack a (bytes, shape[, ndim]) result into g_buf / oshape.
 const float* UnpackArray(PyObject* res, unsigned* oshape, int max_dim,
                          unsigned* out_dim) {
-  if (res == nullptr || res == Py_None) { Py_XDECREF(res); return nullptr; }
+  if (res == nullptr || res == Py_None) {
+    Py_XDECREF(res);
+    // deterministic outputs on the error path (callers may read the
+    // shape/stride before checking the data pointer)
+    for (int i = 0; i < max_dim; ++i) oshape[i] = 0;
+    if (out_dim != nullptr) *out_dim = 0;
+    return nullptr;
+  }
   PyObject* bytes = PyTuple_GetItem(res, 0);   // borrowed
   PyObject* shape = PyTuple_GetItem(res, 1);
   char* data; Py_ssize_t len;

@@ -1,0 +1,20 @@
+"""TPU-native operator library (Pallas kernels + jnp references).
+
+The reference framework's op-extension mechanism is the hand-written
+mshadow expression (e.g. InsanityPoolingExp with a custom Plan,
+/root/reference/src/layer/insanity_pooling_layer-inl.hpp:13-100); the
+TPU-native analog is a Pallas kernel paired with a jnp reference
+implementation, validated by golden tests (the pairtest idea, SURVEY §4).
+"""
+
+from .attention import (
+    attention_reference,
+    chunked_attention,
+    flash_attention,
+)
+
+__all__ = [
+    "attention_reference",
+    "chunked_attention",
+    "flash_attention",
+]

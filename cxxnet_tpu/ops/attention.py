@@ -1,0 +1,264 @@
+"""Multi-head attention: jnp reference, chunked (online-softmax), and a
+Pallas TPU flash-attention kernel.
+
+The reference framework predates attention entirely (fixed 4-D image
+tensors, /root/reference/src/layer/layer.h:33-39; SURVEY §5 "long-context:
+N/A"), so this module is a TPU-idiomatic extension: it makes long-context
+sequence models first-class. Three interchangeable implementations, all
+taking (batch, seq, heads, head_dim) arrays:
+
+* ``attention_reference`` — plain jnp softmax(QK^T)V; O(S^2) memory.
+  The golden implementation every other path is tested against.
+* ``chunked_attention`` — lax.scan over key/value blocks with the online
+  softmax recurrence (running max / normalizer); O(S * block_k) live
+  memory, differentiable through the scan, works on any backend. This is
+  also the backward path for the flash kernel.
+* ``flash_attention`` — Pallas kernel tiling q into MXU-friendly blocks
+  and streaming k/v blocks through VMEM (forward); custom_vjp with the
+  chunked implementation as backward. ``interpret=True`` runs the same
+  kernel on CPU for tests.
+
+Masking convention: ``causal=True`` masks strictly-future positions.
+Fully-masked rows produce zeros (guarded divide), so ragged/padded
+sequences are safe.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG = -1e30
+
+
+def _scale(q: jax.Array, scale: Optional[float]) -> float:
+    return (q.shape[-1] ** -0.5) if scale is None else scale
+
+
+def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
+                        causal: bool = False,
+                        scale: Optional[float] = None) -> jax.Array:
+    """Plain softmax attention. q,k,v: (B, S, H, D) -> (B, S, H, D)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * _scale(q, scale)
+    if causal:
+        qi = lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        ki = lax.broadcasted_iota(jnp.int32, s.shape, 3)
+        s = jnp.where(qi >= ki, s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(p.dtype)).astype(q.dtype)
+
+
+def _online_block_update(acc, m, l, q, kb, vb, q_pos, k_pos, scale, causal,
+                         k_valid_upto=None):
+    """One online-softmax accumulation step against key/value block (kb, vb).
+
+    acc: (B,H,Sq,D) f32, m/l: (B,H,Sq) f32; q: (B,Sq,H,D);
+    kb/vb: (B,Sk,H,D); q_pos: (Sq,), k_pos: (Sk,) global positions.
+    ``k_valid_upto`` masks key positions >= that bound (block tail padding)
+    independently of the causal mask.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, kb,
+                   preferred_element_type=jnp.float32) * scale
+    mask = None
+    if causal:
+        mask = q_pos[:, None] >= k_pos[None, :]
+    if k_valid_upto is not None:
+        valid = (k_pos < k_valid_upto)[None, :]
+        mask = valid if mask is None else jnp.logical_and(mask, valid)
+    if mask is not None:
+        mask = mask[None, None]
+        s = jnp.where(mask, s, _NEG)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # exp under the new running max; explicitly zero masked entries so a
+    # fully-masked block contributes nothing (avoids exp(-NEG+NEG)=1)
+    p = jnp.exp(s - m_new[..., None])
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    acc_new = acc * corr[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", p, vb.astype(jnp.float32))
+    return acc_new, m_new, l_new
+
+
+def chunked_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      causal: bool = False, scale: Optional[float] = None,
+                      block_k: int = 128) -> jax.Array:
+    """Online-softmax attention scanning over k/v blocks (B,S,H,D)."""
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    sc = _scale(q, scale)
+    block_k = min(block_k, Sk)
+    nb = -(-Sk // block_k)
+    pad = nb * block_k - Sk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    kb = k.reshape(B, nb, block_k, H, D).transpose(1, 0, 2, 3, 4)
+    vb = v.reshape(B, nb, block_k, H, D).transpose(1, 0, 2, 3, 4)
+    q_pos = jnp.arange(Sq)
+
+    def step(carry, blk):
+        acc, m, l = carry
+        j, kj, vj = blk
+        k_pos = j * block_k + jnp.arange(block_k)
+        acc, m, l = _online_block_update(
+            acc, m, l, q, kj, vj, q_pos, k_pos, sc, causal,
+            k_valid_upto=Sk if pad else None)
+        return (acc, m, l), None
+
+    acc0 = jnp.zeros((B, H, Sq, D), jnp.float32)
+    m0 = jnp.full((B, H, Sq), _NEG, jnp.float32)
+    l0 = jnp.zeros((B, H, Sq), jnp.float32)
+    (acc, m, l), _ = lax.scan(step, (acc0, m0, l0),
+                              (jnp.arange(nb), kb, vb))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+# -- Pallas flash attention ---------------------------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                      scale, causal, block_q, block_k):
+    """One (batch*head, q-block, k-block) grid cell. K/V truly stream: each
+    cell sees only one (block_k, D) K/V tile in VMEM; the online-softmax
+    accumulators persist in VMEM scratch across the (innermost, sequential)
+    k-block grid dimension, so VMEM residency is O(block) not O(S).
+    """
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32)          # (block_q, D)
+        kb = k_ref[0].astype(jnp.float32)         # (block_k, D)
+        vb = v_ref[0].astype(jnp.float32)
+        s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = kj * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            mask = qpos >= kpos
+            s = jnp.where(mask, s, _NEG)
+        m_prev = m_ref[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[:, 0] = l_ref[:, 0] * corr + jnp.sum(p, axis=-1)
+        acc_ref[...] = acc_ref[...] * corr[:, None] + jnp.dot(
+            p, vb, preferred_element_type=jnp.float32)
+        m_ref[:, 0] = m_new
+
+    if causal:
+        # skip tiles strictly above the causal diagonal
+        @pl.when(kj * block_k <= qi * block_q + block_q - 1)
+        def _guarded():
+            compute()
+    else:
+        compute()
+
+    @pl.when(kj == nk - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[...] /
+                    jnp.maximum(l_ref[:, 0], 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+try:  # pallas import kept lazy-safe: CPU-only installs still get chunked
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAVE_PALLAS = False
+
+
+def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    block_q = min(block_q, Sq)
+    block_k = min(block_k, Sk)
+    if Sq % block_q or Sk % block_k:
+        raise ValueError(
+            f"flash_attention: seq lengths ({Sq},{Sk}) must be divisible by "
+            f"blocks ({block_q},{block_k})")
+    # (B,S,H,D) -> (B*H, S, D): one grid row per (batch, head)
+    qt = q.transpose(0, 2, 1, 3).reshape(B * H, Sq, D)
+    kt = k.transpose(0, 2, 1, 3).reshape(B * H, Sk, D)
+    vt = v.transpose(0, 2, 1, 3).reshape(B * H, Sk, D)
+    kern = functools.partial(
+        _flash_fwd_kernel, scale=_scale(q, scale), causal=causal,
+        block_q=block_q, block_k=block_k)
+    out = pl.pallas_call(
+        kern,
+        grid=(B * H, Sq // block_q, Sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),   # acc
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running max
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running normalizer
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = False, scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """Flash attention (B,S,H,D): Pallas forward, chunked-recompute backward.
+
+    ``interpret=None`` auto-selects interpreter mode off-TPU so the same
+    kernel is exercised in CPU tests (the pairtest spirit, SURVEY §4).
+    """
+    if not _HAVE_PALLAS:   # promised fallback for pallas-less installs
+        return chunked_attention(q, k, v, causal=causal, scale=scale,
+                                 block_k=block_k)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
+    if not _HAVE_PALLAS:
+        out = chunked_attention(q, k, v, causal=causal, scale=scale,
+                                block_k=block_k)
+        return out, (q, k, v)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    out = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    # Backward = differentiate the chunked implementation (recompute);
+    # identical math, O(S * block) live memory under remat.
+    def f(q_, k_, v_):
+        return chunked_attention(q_, k_, v_, causal=causal, scale=scale,
+                                 block_k=block_k)
+    _, vjp = jax.vjp(f, q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)

@@ -26,6 +26,14 @@ from .config import ConfigPairs
 TAGS = ("wmat", "bias")
 
 
+def tag_for_param(param_name: str) -> str:
+    """lr/wd scoping group for a parameter leaf key (reference updater key
+    encoding, updater.h:150-173). LayerNorm gamma/beta follow the bias
+    group so weight decay never pulls the multiplicative gamma toward 0.
+    Single source of truth — Network.param_tag delegates here."""
+    return "bias" if param_name in ("bias", "gamma", "beta") else "wmat"
+
+
 @dataclasses.dataclass
 class UpdaterHyper:
     """Per-tag hyperparameters (reference UpdaterParam)."""
@@ -177,7 +185,7 @@ class Optimizer:
         return {"mom": zeros}
 
     def _tag(self, param_name: str) -> str:
-        return "bias" if param_name == "bias" else "wmat"
+        return tag_for_param(param_name)
 
     def state_pspecs(self, param_pspecs):
         """PartitionSpec tree matching init_state(): momentum/moment buffers

@@ -1,0 +1,101 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a mesh axis.
+
+TPU-idiomatic extension beyond the reference (its only parallelism is data
+parallel + the fullc_gather trick, SURVEY §2.4): a stack of identical
+stages is sharded over a ``'pipe'`` mesh axis (one stage per device group);
+the batch is split into M microbatches that flow through the ring with
+``lax.ppermute`` — device p computes microbatch (t - p) at tick t, so the
+pipeline fills for S-1 ticks, streams, and drains. Forward-only latency is
+(M + S - 1) stage-times; autodiff through the scan + ppermute gives the
+symmetric backward schedule automatically.
+
+API: stage parameters are pytrees with a leading stage axis (S, ...);
+``pipeline_apply`` runs under an existing shard_map (axis bound), and
+``pipeline_sharded`` wraps one call end-to-end on a mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                   stage_params: Any, x: jax.Array, axis_name: str,
+                   n_microbatch: int) -> jax.Array:
+    """Run ``x`` through S pipelined stages under shard_map.
+
+    stage_params: local stage's params (leading stage axis already split by
+    shard_map, size 1) — pytree of (1, ...) arrays.
+    x: the local copy of the FULL batch (replicated over the pipe axis);
+    every device computes the microbatch schedule, but only applies its own
+    stage. Output is the full batch after the last stage (replicated).
+    """
+    S = lax.psum(1, axis_name)
+    me = lax.axis_index(axis_name)
+    M = n_microbatch
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by n_microbatch {M}")
+    mb = B // M
+    xs = x.reshape(M, mb, *x.shape[1:])
+    local_params = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def pvary(a):
+        try:
+            return lax.pcast(a, (axis_name,), to="varying")
+        except (AttributeError, TypeError):
+            return lax.pvary(a, (axis_name,))
+
+    # per-device "current activation" register and output accumulator
+    state0 = pvary(jnp.zeros((mb,) + xs.shape[2:], x.dtype))
+    out0 = pvary(jnp.zeros_like(xs))
+
+    def tick(carry, t):
+        state, out = carry
+        # stage 0 ingests microbatch t (when one remains); other stages use
+        # the activation received from the previous stage
+        feed = jnp.where(t < M, t, M - 1)
+        inp = jnp.where(me == 0, xs[feed], state)
+        y = stage_fn(local_params, inp)
+        # last stage banks its finished microbatch (index t - (S-1))
+        done_idx = jnp.clip(t - (S - 1), 0, M - 1)
+        bank = jnp.logical_and(me == S - 1, t >= S - 1)
+        out = lax.cond(
+            bank,
+            lambda o: lax.dynamic_update_slice(
+                o, y[None].astype(o.dtype), (done_idx,) + (0,) * (o.ndim - 1)),
+            lambda o: o, out)
+        # rotate activations one hop down the pipe
+        state = lax.ppermute(y, axis_name, perm)
+        return (state, out), None
+
+    (_, out), _ = lax.scan(tick, (state0, out0), jnp.arange(M + S - 1))
+    # replicate the last stage's banked outputs to every pipe member so the
+    # caller sees the full result regardless of position
+    out = lax.psum(
+        out * jnp.where(me == S - 1, 1.0, 0.0).astype(out.dtype), axis_name)
+    return out.reshape(B, *out.shape[2:])
+
+
+def pipeline_sharded(mesh: Mesh, stage_fn, stage_params, x: jax.Array,
+                     n_microbatch: int, pipe_axis: str = "pipe") -> jax.Array:
+    """One-call pipeline: stage_params' leading axis shards over
+    ``pipe_axis``; x is replicated; returns the full-batch output."""
+    pparam_spec = jax.tree_util.tree_map(
+        lambda _: P(pipe_axis), stage_params)
+    fn = jax.shard_map(
+        functools.partial(pipeline_apply, stage_fn, axis_name=pipe_axis,
+                          n_microbatch=n_microbatch),
+        mesh=mesh,
+        in_specs=(pparam_spec, P()),
+        out_specs=P(),
+    )
+    return fn(stage_params, x)

@@ -262,7 +262,26 @@ def train(cfg: Union[str, ConfigPairs], data, label=None, num_round: int = 1,
             if not silent and line:
                 print(f"round {r}{line}")
     else:
+        # raw-array branch: honor the configured batch_size by minibatching
+        # (improvement over the reference loop, which updates on the whole
+        # array at once — wrapper/cxxnet.py:309-314); tail is padded+masked.
+        arr = _to_nhwc(data, net._layout)
+        lab = _to_label(label, arr.shape[0])
+        bs = net._build().batch_size
+        n = arr.shape[0]
         for r in range(num_round):
             net.start_round(r)
-            net.update(data=data, label=label)
+            for off in range(0, n, bs):
+                d, l = arr[off:off + bs], lab[off:off + bs]
+                padd = bs - d.shape[0]
+                if padd:
+                    d = np.concatenate([d, np.repeat(d[-1:], padd, 0)])
+                    l = np.concatenate([l, np.repeat(l[-1:], padd, 0)])
+                net.update(DataBatch(data=d, label=l, num_batch_padd=padd))
+            line = net.trainer.train_metric_report("train") \
+                if net.trainer.eval_train else ""
+            if eval_data is not None:
+                line += net.evaluate(eval_data, "eval")
+            if not silent and line:
+                print(f"round {r}{line}")
     return net
