@@ -70,6 +70,16 @@ class LearnTask:
         self.extract_node_name = gp("extract_node_name", "top")
         self.name_pred = gp("name_pred", "pred.txt")
         self.silent = int(gp("silent", "0"))
+        # test_io=1: run the full input pipeline but skip Update — isolates
+        # input throughput (reference cxxnet_main.cpp:455-469, doc/debug_perf.md)
+        self.test_io = int(gp("test_io", "0"))
+        # profile_dir=<path>: capture a profiler trace of the train loop
+        # (view with xprof/tensorboard); the reference prescribed external
+        # tools only (doc/debug_perf.md) — built-in here
+        self.profile_dir = gp("profile_dir", "")
+        # multi-host bring-up before any device queries (rabit::Init analog)
+        from .parallel import maybe_distributed_init
+        maybe_distributed_init(self.global_cfg)
         self.trainer = Trainer(self.global_cfg)
 
     # -- iterators ---------------------------------------------------------
@@ -138,18 +148,52 @@ class LearnTask:
             raise ValueError("no training data section (data = ...) in config")
         evals = self.eval_iters()
         os.makedirs(self.model_dir, exist_ok=True)
+        if self.profile_dir:
+            import jax
+            jax.profiler.start_trace(self.profile_dir)
+        try:
+            self._train_rounds(tr, itr_train, evals)
+        finally:
+            # finalize the trace even when the loop dies mid-round — the
+            # crashing/interrupted run is the one whose profile matters
+            if self.profile_dir:
+                import jax
+                jax.profiler.stop_trace()
+                if not self.silent:
+                    print(f"profiler trace written to {self.profile_dir}")
+        if self.save_model and not self.test_io:
+            final = ckpt.model_path(self.model_dir, self.num_round - 1)
+            if not os.path.exists(final):
+                tr.save_model(final)
+
+    def _train_rounds(self, tr, itr_train, evals) -> None:
         start = time.time()
         for r in range(self.start_counter, self.num_round):
             tr.start_round(r)
             batch_count = 0
+            n_images = 0
+            round_start = time.time()
             for batch in itr_train:
+                if self.test_io:
+                    n_images += batch.batch_size - batch.num_batch_padd
+                    batch_count += 1
+                    continue
                 tr.update(batch)
+                n_images += batch.batch_size - batch.num_batch_padd
                 batch_count += 1
                 if self.print_step and batch_count % self.print_step == 0 \
                         and not self.silent:
                     elapsed = int(time.time() - start)
+                    ips = n_images / max(time.time() - round_start, 1e-9)
                     print(f"round {r:8d}:[{batch_count:8d}] {elapsed} sec "
-                          f"elapsed, loss={tr.last_loss:.6f}", flush=True)
+                          f"elapsed, loss={tr.last_loss:.6f}, "
+                          f"{ips:.1f} images/sec", flush=True)
+            if self.test_io:
+                dt = max(time.time() - round_start, 1e-9)
+                print(f"round {r:8d}: test_io {n_images} images in "
+                      f"{dt:.2f} sec = {n_images / dt:.1f} images/sec",
+                      flush=True)
+                continue
             line = f"round {r:8d}:[{int(time.time() - start)} sec]"
             if tr.eval_train:
                 line += tr.train_metric_report("train")
@@ -161,10 +205,6 @@ class LearnTask:
             if self.save_model and self.save_period \
                     and (r + 1) % self.save_period == 0:
                 tr.save_model(ckpt.model_path(self.model_dir, r))
-        if self.save_model:
-            final = ckpt.model_path(self.model_dir, self.num_round - 1)
-            if not os.path.exists(final):
-                tr.save_model(final)
 
     def task_predict(self) -> None:
         tr = self.trainer

@@ -1,3 +1,5 @@
-from .mesh import MeshContext, make_mesh_context, parse_device_spec
+from .mesh import (MeshContext, allreduce_metric_pairs, make_mesh_context,
+                   maybe_distributed_init, parse_device_spec)
 
-__all__ = ["MeshContext", "make_mesh_context", "parse_device_spec"]
+__all__ = ["MeshContext", "make_mesh_context", "parse_device_spec",
+           "maybe_distributed_init", "allreduce_metric_pairs"]

@@ -140,6 +140,48 @@ class MeshContext:
         return jax.tree_util.tree_map(g, tree)
 
 
+def maybe_distributed_init(cfg) -> bool:
+    """Multi-host bring-up (the analog of rabit::Init / the ps-lite tracker
+    handshake, reference cxxnet_main.cpp:74-92): when the config carries
+    ``dist_coordinator`` (host:port), call jax.distributed.initialize so
+    jax.devices() spans every host and the same mesh code scales over DCN.
+    Process count/rank come from ``dist_num_proc``/``dist_rank`` or the
+    standard cluster env detection. Returns True when initialization ran.
+
+    Config keys: dist_coordinator, dist_num_proc, dist_rank.
+    """
+    coord = num = rank = None
+    for k, v in cfg:
+        if k == "dist_coordinator":
+            coord = v
+        elif k == "dist_num_proc":
+            num = int(v)
+        elif k == "dist_rank":
+            rank = int(v)
+    if not coord:
+        return False
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=num, process_id=rank)
+    return True
+
+
+def allreduce_metric_pairs(pairs):
+    """Sum (sum, cnt) metric accumulators across hosts — the TPU-native
+    analog of the reference's rabit allreduce inside Metric::Get
+    (utils/metric.h:60-68). Identity in single-process runs."""
+    if jax.process_count() == 1:
+        return pairs
+    from jax.experimental import multihost_utils
+    arr = np.asarray(pairs, np.float64)          # (n_metrics, 2)
+    # allgather moves data through jnp, which would canonicalize float64 to
+    # float32 without x64 mode (corrupting counts > 2^24); bit-cast to
+    # uint32 for the transport and reassemble host-side.
+    bits = np.ascontiguousarray(arr).view(np.uint32)
+    gathered = multihost_utils.process_allgather(bits)  # (n_proc, n, 4)
+    tot = np.sum(np.asarray(gathered).view(np.float64), axis=0)
+    return [(float(s), int(c)) for s, c in tot]
+
+
 def make_mesh_context(dev: str = "tpu",
                       devices: Optional[Sequence] = None,
                       model_parallel: int = 1) -> MeshContext:

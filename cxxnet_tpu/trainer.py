@@ -147,18 +147,43 @@ class Trainer:
         self.round_counter = round_counter
 
     # -- weights API (reference SetWeight/GetWeight, nnet.h:69-91) ---------
+    def _walk(self, tree, layer_name: str, tag: str):
+        """Resolve a (layer, tag) pair; tag may be a dotted path into nested
+        param dicts (e.g. 'q.wmat' for mha layers)."""
+        node = tree[layer_name]
+        for part in tag.split("."):
+            node = node[part]
+        return node
+
     def get_weight(self, layer_name: str, tag: str) -> np.ndarray:
-        return np.asarray(self.mesh.gather(self.params[layer_name][tag]))
+        return np.asarray(self.mesh.gather(
+            self._walk(self.params, layer_name, tag)))
 
     def set_weight(self, weight: np.ndarray, layer_name: str, tag: str) -> None:
-        cur = self.params[layer_name][tag]
-        if tuple(weight.shape) != tuple(cur.shape):
-            raise ValueError(
-                f"set_weight: shape {weight.shape} != {tuple(cur.shape)}")
+        self.set_weights({(layer_name, tag): weight})
+
+    def set_weights(self, updates) -> None:
+        """Bulk weight assignment: one device->host gather and one placement
+        for any number of tensors (``updates``: {(layer, dotted_tag): array}).
+        """
+        for (layer, tag), w in updates.items():
+            cur = self._walk(self.params, layer, tag)
+            if tuple(np.shape(w)) != tuple(cur.shape):
+                raise ValueError(
+                    f"set_weight {layer}.{tag}: shape {np.shape(w)} != "
+                    f"{tuple(cur.shape)}")
         p = ckpt.jax_to_numpy(self.mesh.gather(self.params))
-        p[layer_name][tag] = np.asarray(weight,
-                                        dtype=p[layer_name][tag].dtype)
+        for (layer, tag), w in updates.items():
+            parts = tag.split(".")
+            node = p[layer]
+            for part in parts[:-1]:
+                node = node[part]
+            node[parts[-1]] = np.asarray(w, dtype=node[parts[-1]].dtype)
         self.params = self._place(p)
+
+    def param_layer_names(self):
+        """Top-level layer names present in the param tree."""
+        return list(self.params.keys())
 
     # -- train step --------------------------------------------------------
     def _needed_nodes(self) -> List[str]:
@@ -276,17 +301,27 @@ class Trainer:
 
     def evaluate(self, data_iter, name: str) -> str:
         """Run all metrics over an iterator; returns the reference's round
-        log fragment ``\\tname-metric:value`` (nnet_impl-inl.hpp:241-276)."""
+        log fragment ``\\tname-metric:value`` (nnet_impl-inl.hpp:241-276).
+        In multi-host runs each process evaluates its own shard and the
+        (sum, cnt) accumulators are all-reduced, like the reference's rabit
+        allreduce inside Metric::Get (metric.h:60-68)."""
+        from .parallel import allreduce_metric_pairs
         self.metric.clear()
         for batch in data_iter:
             nodes = self._eval_nodes(batch)
             self._add_metric(self.metric, nodes, batch)
+        if jax.process_count() > 1:
+            self.metric.set_pairs(allreduce_metric_pairs(self.metric.pairs()))
         out = ""
         for mname, val in self.metric.get(name):
             out += "\t%s:%f" % (mname, val)
         return out
 
     def train_metric_report(self, name: str = "train") -> str:
+        if jax.process_count() > 1:   # same global reduction as evaluate()
+            from .parallel import allreduce_metric_pairs
+            self.train_metric.set_pairs(
+                allreduce_metric_pairs(self.train_metric.pairs()))
         out = ""
         for mname, val in self.train_metric.get(name):
             out += "\t%s:%f" % (mname, val)

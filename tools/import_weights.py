@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Import external weights into a cxxnet_tpu model checkpoint.
+
+The caffe-converter analog (reference tools/caffe_converter/convert.cpp:30-187
+copies Caffe blobs into same-named cxxnet layers through SetWeightVisitor).
+Here the source is an ``.npz`` file or a torch ``state_dict`` (.pt/.pth),
+weights land in same-named layers via Trainer.set_weight (shape-checked),
+and the result is saved as a normal ``.model`` checkpoint.
+
+Name conventions:
+  * npz: keys are ``<layer>.<tag>`` (tags: wmat/bias/gamma/beta/...),
+    arrays already in this framework's layouts (fullc (in,out);
+    conv HWIO (kh,kw,cin,cout)).
+  * torch: keys are ``<layer>.weight`` / ``<layer>.bias``; Linear weights
+    (out,in) are transposed to (in,out), Conv2d weights (out,in,kh,kw)
+    are transposed to HWIO automatically.
+  * ``--map src=dst`` renames source layers (repeatable).
+
+Usage:
+  python tools/import_weights.py <net.conf> <weights.npz|.pt> <out.model>
+      [--format npz|torch] [--map src=dst ...] [--strict]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cxxnet_tpu.config import parse_config_file
+from cxxnet_tpu.main import split_sections
+from cxxnet_tpu.trainer import Trainer
+
+
+def load_npz(path):
+    """{dotted_key: array} from an npz of '<layer>.<tag...>' keys."""
+    out = {}
+    with np.load(path) as z:
+        for key in z.files:
+            if "." not in key:
+                raise ValueError(f"npz key {key!r} is not '<layer>.<tag>'")
+            out[key] = np.asarray(z[key], np.float32)
+    return out
+
+
+def load_torch(path):
+    """{dotted_key: array} from a torch state_dict, translating leaf names
+    (weight->wmat, transposed) and layouts into this framework's."""
+    import torch
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    if hasattr(sd, "state_dict"):
+        sd = sd.state_dict()
+    out = {}
+    for key, t in sd.items():
+        if "." not in key:
+            continue
+        prefix, leaf = key.rsplit(".", 1)
+        a = t.detach().cpu().numpy().astype(np.float32)
+        if leaf == "weight":
+            if a.ndim == 2:            # Linear (out,in) -> (in,out)
+                a = a.T
+            elif a.ndim == 4:          # Conv2d (out,in,kh,kw) -> HWIO
+                a = a.transpose(2, 3, 1, 0)
+            out[prefix + ".wmat"] = np.ascontiguousarray(a)
+        elif leaf == "bias":
+            out[prefix + ".bias"] = a
+        else:                          # e.g. LayerNorm gamma/beta-style leaves
+            out[prefix + "." + leaf] = a
+    return out
+
+
+def resolve_key(key: str, layer_names, rename):
+    """Split a dotted source key into (layer, dotted_tag) by matching the
+    longest renamed prefix against the target net's layer names — so nested
+    params ('attn.q.wmat' -> layer 'attn', tag 'q.wmat') resolve too.
+    Returns None when no prefix matches."""
+    parts = key.split(".")
+    for i in range(len(parts) - 1, 0, -1):
+        prefix = ".".join(parts[:i])
+        layer = rename.get(prefix, prefix)
+        if layer in layer_names:
+            return layer, ".".join(parts[i:])
+    return None
+
+
+def import_weights(cfg_path: str, src_path: str, out_path: str,
+                   fmt: str = "", rename=None, strict: bool = False,
+                   verbose: bool = True) -> int:
+    """Returns the number of imported tensors."""
+    if not fmt:
+        fmt = "torch" if src_path.endswith((".pt", ".pth")) else "npz"
+    weights = load_torch(src_path) if fmt == "torch" else load_npz(src_path)
+    rename = dict(rename or {})
+
+    cfg = parse_config_file(cfg_path)
+    global_cfg, _ = split_sections(cfg)
+    tr = Trainer(global_cfg + [("dev", "cpu")])
+    tr.init_model()
+    layer_names = set(tr.param_layer_names())
+
+    updates = {}
+    for key, arr in sorted(weights.items()):
+        resolved = resolve_key(key, layer_names, rename)
+        if resolved is None:
+            msg = f"skip {key}: no matching layer in target net"
+            if strict:
+                raise KeyError(msg)
+            if verbose:
+                print(msg)
+            continue
+        layer, tag = resolved
+        try:
+            cur = tr.get_weight(layer, tag)
+        except (KeyError, TypeError):
+            cur = None
+        if cur is None:
+            msg = f"skip {key}: layer {layer!r} has no param {tag!r}"
+            if strict:
+                raise KeyError(msg)
+            if verbose:
+                print(msg)
+            continue
+        if tuple(cur.shape) != tuple(arr.shape):
+            msg = (f"skip {key}: shape {arr.shape} != "
+                   f"target {tuple(cur.shape)}")
+            if strict:
+                raise ValueError(msg)
+            if verbose:
+                print(msg)
+            continue
+        updates[(layer, tag)] = arr
+        if verbose:
+            print(f"copied {key} -> {layer}.{tag} {arr.shape}")
+    # single gather + placement for the whole batch of tensors
+    tr.set_weights(updates)
+    tr.save_model(out_path)
+    if verbose:
+        print(f"imported {len(updates)} tensors -> {out_path}")
+    return len(updates)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("config")
+    ap.add_argument("source")
+    ap.add_argument("output")
+    ap.add_argument("--format", choices=("npz", "torch"), default="")
+    ap.add_argument("--map", action="append", default=[],
+                    metavar="SRC=DST", help="rename source layer SRC to DST")
+    ap.add_argument("--strict", action="store_true",
+                    help="error (instead of skip) on unmatched tensors")
+    args = ap.parse_args(argv)
+    rename = dict(m.split("=", 1) for m in args.map)
+    import_weights(args.config, args.source, args.output, args.format,
+                   rename, args.strict)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
