@@ -1,0 +1,115 @@
+"""BinaryPage pack format: the reference's legacy image-pack container.
+
+Byte-compatible with /root/reference/src/utils/io.h:99-172 (``BinaryPage``,
+64 MiB fixed pages of int32): word 0 holds the object count N, words
+1..N+1 hold cumulative end-offsets (word 1 is 0), and object bytes grow
+backward from the END of the page — object k occupies bytes
+``[PAGE_BYTES - end[k+1], PAGE_BYTES - end[k+1] + (end[k+1]-end[k]))``.
+``tools/im2bin.py`` packs jpegs into this format and ``tools/bin2rec.py``
+converts packs to recordio; the imgbin iterator reads packs directly
+(labels ride the companion ``.lst`` file, k-th object = k-th list line,
+matching the reference's ThreadImagePageIterator contract,
+iter_thread_imbin-inl.hpp:17-284).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterator, List, Tuple
+
+import numpy as np
+
+PAGE_INTS = 64 << 18
+PAGE_BYTES = PAGE_INTS * 4
+
+
+class BinaryPageWriter:
+    """Pack byte objects into fixed 64 MiB pages (reference
+    BinaryPage::Push + tools/im2bin.cpp main loop)."""
+
+    def __init__(self, path: str):
+        self._f = open(path, "wb")
+        self._clear()
+
+    def _clear(self) -> None:
+        self._objs: List[bytes] = []
+        self._data_bytes = 0
+
+    def _free_bytes(self) -> int:
+        # mirror reference FreeBytes: (kPageSize - (N + 2)) ints - data
+        return (PAGE_INTS - (len(self._objs) + 2)) * 4 - self._data_bytes
+
+    def push(self, data: bytes) -> None:
+        if len(data) + 4 > self._free_bytes():
+            self.flush_page()
+            # re-check against an empty page (reference im2bin.cpp checks
+            # the retried Push too): an over-page object must error, never
+            # be written out of bounds
+            if len(data) + 4 > self._free_bytes():
+                raise ValueError(
+                    f"object of {len(data)} bytes exceeds the 64MiB page")
+        self._objs.append(data)
+        self._data_bytes += len(data)
+
+    def flush_page(self) -> None:
+        if not self._objs:
+            return
+        page = bytearray(PAGE_BYTES)
+        n = len(self._objs)
+        struct.pack_into("<i", page, 0, n)
+        end = 0
+        for k, obj in enumerate(self._objs):
+            end += len(obj)
+            struct.pack_into("<i", page, 4 * (k + 2), end)
+            page[PAGE_BYTES - end:PAGE_BYTES - end + len(obj)] = obj
+        self._f.write(bytes(page))
+        self._clear()
+
+    def close(self) -> None:
+        self.flush_page()
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def page_object_count(path: str, page_idx: int) -> int:
+    """Object count of one page without reading the full 64 MiB."""
+    with open(path, "rb") as f:
+        f.seek(page_idx * PAGE_BYTES)
+        return struct.unpack("<i", f.read(4))[0]
+
+
+def num_pages(path: str) -> int:
+    size = os.path.getsize(path)
+    if size % PAGE_BYTES:
+        raise ValueError(f"{path}: size {size} is not a whole number of "
+                         f"64MiB BinaryPages")
+    return size // PAGE_BYTES
+
+
+def iter_binpage(path: str, part: int = 0, nsplit: int = 1) \
+        -> Iterator[Tuple[int, bytes]]:
+    """Yield (global_object_index, object_bytes) for this worker's share of
+    pages (page-granularity sharding, like the reference's per-worker file
+    partitioning)."""
+    n_pages = num_pages(path)
+    # global start index of each page (cheap header reads)
+    counts = [page_object_count(path, p) for p in range(n_pages)]
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]]).astype(np.int64)
+    with open(path, "rb") as f:
+        for p in range(part, n_pages, nsplit):
+            f.seek(p * PAGE_BYTES)
+            page = f.read(PAGE_BYTES)
+            hdr = np.frombuffer(page, "<i4", counts[p] + 2)
+            prev = 0
+            for k in range(counts[p]):
+                end = int(hdr[k + 2])
+                size = end - prev
+                yield (int(starts[p] + k),
+                       page[PAGE_BYTES - end:PAGE_BYTES - end + size])
+                prev = end

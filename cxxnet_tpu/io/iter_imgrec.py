@@ -56,8 +56,12 @@ class ImageRecordIterator(DataIter):
     """Batched, augmented, sharded image-record reader."""
 
     def set_param(self, name, val):
-        if name in ("image_rec", "image_bin", "path_imgrec"):
+        if name in ("image_rec", "path_imgrec"):
             self.rec_path = val
+        elif name in ("image_bin", "path_imgbin"):
+            # legacy BinaryPage pack (reference iter_thread_imbin); labels
+            # come from the k-th line of image_list
+            self.bin_path = val
         elif name in ("image_list", "path_imglist"):
             self.list_path = val
         elif name == "batch_size":
@@ -85,6 +89,7 @@ class ImageRecordIterator(DataIter):
 
     def __init__(self, cfg):
         self.rec_path = ""
+        self.bin_path = ""
         self.list_path = ""
         self.batch_size = 128
         self.input_shape = None
@@ -102,17 +107,22 @@ class ImageRecordIterator(DataIter):
 
     # -- setup -------------------------------------------------------------
     def init(self):
-        if not self.rec_path:
-            raise ValueError("imgrec: image_rec must be set")
+        if not self.rec_path and not self.bin_path:
+            raise ValueError("imgrec: image_rec (or image_bin) must be set")
+        if self.bin_path and not self.list_path:
+            raise ValueError("imgbin: image_list must accompany image_bin "
+                             "(labels live in the list)")
         if self.input_shape is None:
             raise ValueError("imgrec: input_shape must be set")
         c, y, x = self.input_shape
         self.augmenter = ImageAugmenter(self.aug, (c, y, x))
         self.mean = MeanStore(mean_cache_path(self.aug), (y, x, c))
         self._label_map = None
+        self._list_entries = None
         if self.list_path:
+            self._list_entries = read_image_list(self.list_path)   # once
             self._label_map = {idx: lab for idx, lab, _
-                               in read_image_list(self.list_path)}
+                               in self._list_entries}
         self._pool = futures.ThreadPoolExecutor(self.nthread)
         self._rng = np.random.RandomState(self.seed + 7 * self.rank)
         # monotonically increasing per-item augmentation counter, hashed
@@ -124,8 +134,22 @@ class ImageRecordIterator(DataIter):
         self.before_first()
 
 
-    def _reader(self) -> RecordReader:
-        return RecordReader(self.rec_path, self.rank, self.nworker)
+    def _reader(self):
+        """Iterable of packed ImageRecord payloads: recordio, or a legacy
+        BinaryPage pack re-wrapped on the fly (k-th object pairs with the
+        k-th image_list line for inst_id/label)."""
+        if not self.bin_path:
+            return RecordReader(self.rec_path, self.rank, self.nworker)
+        from .binpage import iter_binpage
+        entries = self._list_entries          # parsed once in init()
+
+        def gen():
+            for obj_idx, data in iter_binpage(self.bin_path, self.rank,
+                                              self.nworker):
+                inst_id, labels, _ = entries[obj_idx]
+                yield ImageRecord(inst_id=inst_id, labels=labels,
+                                  data=data).pack()
+        return gen()
 
     def _compute_mean(self):
         if not self.silent:

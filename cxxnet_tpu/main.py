@@ -80,6 +80,14 @@ class LearnTask:
         # multi-host bring-up before any device queries (rabit::Init analog)
         from .parallel import maybe_distributed_init
         maybe_distributed_init(self.global_cfg)
+        # non-zero ranks suppress progress logging (reference TrackerPrint,
+        # utils.h:103-113); checkpoint *collectives* still run on every rank
+        # (Trainer.save_model gathers everywhere, writes on rank 0 only) so
+        # model-sharded params never deadlock on a one-sided gather
+        import jax
+        self._is_root = jax.process_index() == 0
+        if not self._is_root:
+            self.silent = 1
         self.trainer = Trainer(self.global_cfg)
 
     # -- iterators ---------------------------------------------------------
@@ -199,7 +207,10 @@ class LearnTask:
                 line += tr.train_metric_report("train")
             for name, itr in evals:
                 line += tr.evaluate(itr, name)
-            print(line, flush=True)
+            # the metric line always prints on the root rank, even under
+            # silent=1 (reference emits it via TrackerPrint regardless)
+            if self._is_root:
+                print(line, flush=True)
             # save_period == 0 means "never save periodically"
             # (reference cxxnet_main.cpp:220)
             if self.save_model and self.save_period \

@@ -153,12 +153,15 @@ class MetricSet:
             m.clear()
 
     def add_eval(self, node_values: Dict[Optional[str], np.ndarray],
-                 label: np.ndarray,
+                 node_labels: Dict[Optional[str], np.ndarray],
                  label_slices: Dict[str, Tuple[int, int]]) -> None:
         """node_values maps node-name (or None for top) to (n, k) scores for
-        the *real* (unpadded) rows; label is the full (n, w) label block."""
+        the real (unpadded) rows this process holds; node_labels carries the
+        row-aligned (n, w) label block per node (rows can differ per node in
+        multi-host runs when some nodes are replicated)."""
         for m, node in zip(self.metrics, self.nodes):
             pred = node_values[node]
+            label = node_labels[node]
             a, b = label_slices[m.label_field]
             m.add(np.asarray(pred), np.asarray(label[:, a:b]))
 

@@ -118,11 +118,16 @@ class Trainer:
         self._init_accum(params)
 
     def save_model(self, path: str) -> None:
+        # the gathers are cross-host collectives when params are
+        # model-sharded: every rank must execute them; only rank 0 writes
+        params = self.mesh.gather(self.params)
+        opt = self.mesh.gather(self.opt_state)
+        if jax.process_index() != 0:
+            return
         ckpt.save_model(
             path, structure_sig=self.graph.structure_signature(),
             round_counter=self.round_counter, epoch_counter=self.epoch_counter,
-            params=self.mesh.gather(self.params), net_state=self.net_state,
-            opt_state=self.mesh.gather(self.opt_state))
+            params=params, net_state=self.net_state, opt_state=opt)
 
     def load_model(self, path: str) -> None:
         blob = ckpt.load_model(path)
@@ -260,19 +265,51 @@ class Trainer:
             mask[batch.batch_size - batch.num_batch_padd:] = 0.0
         return self.mesh.shard_batch(mask)
 
+    def _local_rows(self, arr) -> Tuple[np.ndarray, np.ndarray]:
+        """Host copy of the batch rows this process can address, plus their
+        global row indices. Single-process: all rows. Multi-host: only the
+        local shard rows — each process scores its shard and the (sum,cnt)
+        accumulators are all-reduced (reference metric.h:60-68 semantics)."""
+        if jax.process_count() == 1:
+            x = np.asarray(arr)
+            return x.reshape(x.shape[0], -1), np.arange(x.shape[0])
+        # a node sharded beyond the batch axis (e.g. TP column shards) must
+        # be resharded to batch-only first, or the start-keyed dedupe below
+        # would drop columns; this device_put runs symmetrically on every
+        # rank, so the collective is well-formed
+        if any(tuple(sh.data.shape[1:]) != tuple(arr.shape[1:])
+               for sh in arr.addressable_shards):
+            arr = jax.device_put(arr, self.mesh.batch_sharding(arr.ndim))
+        seen: Dict[int, np.ndarray] = {}
+        for sh in arr.addressable_shards:
+            sl = sh.index[0] if sh.index else slice(None)
+            start = sl.start or 0
+            if start not in seen:     # replicated arrays: dedupe copies
+                seen[start] = np.asarray(sh.data)
+        starts = sorted(seen)
+        rows = np.concatenate(
+            [seen[s].reshape(seen[s].shape[0], -1) for s in starts])
+        idx = np.concatenate(
+            [np.arange(s, s + seen[s].shape[0]) for s in starts])
+        return rows, idx
+
     def _add_metric(self, mset: MetricSet, nodes: Dict[str, jax.Array],
                     batch: DataBatch) -> None:
         n_real = batch.batch_size - batch.num_batch_padd
         if n_real <= 0:
             return
+        label = np.asarray(batch.label)
         node_vals = {}
+        node_labels = {}
         for key, arr in nodes.items():
-            a = np.asarray(arr)
-            node_vals[None if key == _TOP else key] = \
-                a.reshape(a.shape[0], -1)[:n_real]
+            rows, idx = self._local_rows(arr)
+            keep = idx < n_real          # drop tail padding rows
+            name = None if key == _TOP else key
+            node_vals[name] = rows[keep]
+            node_labels[name] = label[idx[keep]]
         slices = {name: self.graph.label_slice(name)
                   for name in self.graph.label_name_map}
-        mset.add_eval(node_vals, np.asarray(batch.label)[:n_real], slices)
+        mset.add_eval(node_vals, node_labels, slices)
 
     # -- evaluation / inference -------------------------------------------
     def _make_eval_step(self, extract: Tuple[str, ...] = ()):

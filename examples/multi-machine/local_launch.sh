@@ -1,0 +1,27 @@
+#!/bin/sh
+# Local multi-process simulation of a multi-host job (the analog of the
+# reference's ps-lite local.sh mode, example/multi-machine/run.sh:14-15):
+# N worker processes on this machine, each with CXXNET_CPU_DEVICES virtual
+# CPU devices, joined through jax.distributed into one data-parallel mesh.
+#
+# Usage: sh local_launch.sh [nproc] [config] [extra key=value ...]
+set -e
+cd "$(dirname "$0")"
+NPROC=${1:-2}
+CONF=${2:-../synthetic_mlp.conf}
+shift 2 2>/dev/null || shift $# 2>/dev/null || true
+PORT=$((20000 + $$ % 10000))
+
+PIDS=""
+for i in $(seq 0 $((NPROC - 1))); do
+  CXXNET_CPU_DEVICES=${CXXNET_CPU_DEVICES:-2} JAX_PLATFORMS=cpu \
+  python worker.py "$CONF" \
+      dist_coordinator=localhost:$PORT dist_num_proc=$NPROC dist_rank=$i \
+      "$@" &
+  PIDS="$PIDS $!"
+done
+RC=0
+for p in $PIDS; do
+  wait "$p" || RC=1
+done
+exit $RC

@@ -191,3 +191,22 @@ def test_net_train_eval_weights(lib, tmp_path):
     lib.CXNNetFree(net2)
     lib.CXNNetFree(net)
     lib.CXNIOFree(it)
+
+
+def test_c_host_demo(tmp_path):
+    """Compile and run the pure-C host demo: exercises the C ABI exactly as
+    a MATLAB/C consumer would (dlopen + embedded interpreter)."""
+    import subprocess
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    exe = str(tmp_path / "c_demo")
+    build = subprocess.run(
+        ["gcc", os.path.join(repo, "wrapper", "c_demo.c"),
+         "-I" + os.path.join(repo, "wrapper"), "-o", exe, "-ldl"],
+        capture_output=True, text=True)
+    assert build.returncode == 0, build.stderr
+    run = subprocess.run(
+        [exe], capture_output=True, text=True, cwd=repo, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": repo,
+             "CXXNET_CAPI": _LIB})
+    assert run.returncode == 0, (run.stdout, run.stderr[-2000:])
+    assert "train-error:0.0" in run.stdout

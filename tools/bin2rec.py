@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""Convert a legacy BinaryPage ``.bin`` pack (+ its ``.lst``) to recordio.
+
+Reference parity: tools/bin2rec.cc. The k-th packed object pairs with the
+k-th list line for inst_id/labels.
+
+Usage:
+    python tools/bin2rec.py train.bin train.lst train.rec
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cxxnet_tpu.io.binpage import iter_binpage
+from cxxnet_tpu.io.recordio import ImageRecord, RecordWriter, read_image_list
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("bin", help="input .bin pack")
+    ap.add_argument("lst", help="image list file (labels)")
+    ap.add_argument("out", help="output .rec path")
+    args = ap.parse_args()
+
+    entries = read_image_list(args.lst)
+    n = 0
+    with RecordWriter(args.out) as w:
+        for obj_idx, data in iter_binpage(args.bin):
+            inst_id, labels, _ = entries[obj_idx]
+            w.write(ImageRecord(inst_id=inst_id, labels=labels,
+                                data=data).pack())
+            n += 1
+            if n % 1000 == 0:
+                print(f"{n} records", flush=True)
+    print(f"wrote {args.out}: {n} records")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""Pack an image list into the legacy BinaryPage ``.bin`` format.
+
+Reference parity: tools/im2bin.cpp — raw image bytes pushed into fixed
+64 MiB BinaryPages in list order (labels stay in the ``.lst`` file; the
+imgbin iterator pairs the k-th packed object with the k-th list line).
+
+Usage:
+    python tools/im2bin.py train.lst image_root/ train.bin
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cxxnet_tpu.io.binpage import BinaryPageWriter
+from cxxnet_tpu.io.recordio import read_image_list
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("lst", help="image list file")
+    ap.add_argument("root", help="image root directory")
+    ap.add_argument("out", help="output .bin path")
+    args = ap.parse_args()
+
+    items = read_image_list(args.lst)
+    n = 0
+    with BinaryPageWriter(args.out) as w:
+        for idx, labels, rel in items:
+            with open(os.path.join(args.root, rel), "rb") as f:
+                w.push(f.read())
+            n += 1
+            if n % 1000 == 0:
+                print(f"{n} images packed", flush=True)
+    print(f"wrote {args.out}: {n} images")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,52 @@
+classdef DataIter < handle
+  % DataIter: MATLAB binding of a cxxnet_tpu data iterator (reference
+  % wrapper/matlab/DataIter.m) over the C ABI.
+  %
+  %   it = DataIter(sprintf('iter = mnist\npath_img = ...\n'));
+  %   while it.next()
+  %     data = it.get_data();    % (batch,channel,y,x) single
+  %   end
+
+  properties (Hidden)
+    handle
+  end
+
+  methods
+    function obj = DataIter(cfg)
+      obj.handle = calllib('cxxnet_capi', 'CXNIOCreateFromConfig', cfg);
+      assert(~isNull(obj.handle), 'CXNIOCreateFromConfig failed');
+    end
+
+    function delete(obj)
+      if ~isempty(obj.handle)
+        calllib('cxxnet_capi', 'CXNIOFree', obj.handle);
+      end
+    end
+
+    function ok = next(obj)
+      ok = calllib('cxxnet_capi', 'CXNIONext', obj.handle) ~= 0;
+    end
+
+    function before_first(obj)
+      calllib('cxxnet_capi', 'CXNIOBeforeFirst', obj.handle);
+    end
+
+    function d = get_data(obj)
+      shp = libpointer('uint32Ptr', zeros(1, 4, 'uint32'));
+      stride = libpointer('uint32Ptr', uint32(0));
+      p = calllib('cxxnet_capi', 'CXNIOGetData', obj.handle, shp, stride);
+      dims = double(shp.Value);
+      setdatatype(p, 'singlePtr', 1, prod(dims));
+      d = permute(reshape(p.Value, fliplr(dims)), 4:-1:1);
+    end
+
+    function l = get_label(obj)
+      shp = libpointer('uint32Ptr', zeros(1, 2, 'uint32'));
+      stride = libpointer('uint32Ptr', uint32(0));
+      p = calllib('cxxnet_capi', 'CXNIOGetLabel', obj.handle, shp, stride);
+      dims = double(shp.Value);
+      setdatatype(p, 'singlePtr', 1, prod(dims));
+      l = reshape(p.Value, fliplr(dims))';
+    end
+  end
+end

@@ -1,0 +1,103 @@
+classdef Net < handle
+  % Net: MATLAB binding of the cxxnet_tpu trainer.
+  % Reference analog: wrapper/matlab/Net.m over the MEX dispatcher; here
+  % the binding goes through loadlibrary/calllib on the plain C ABI
+  % (libcxxnet_capi.so), so no MEX compilation is needed.
+  %
+  %   cxxnet_load();                       % loadlibrary once per session
+  %   net = Net('cpu', fileread('net.conf'));
+  %   net.init_model();
+  %   net.update_iter(it);                 % it = DataIter(...)
+  %   s = net.evaluate(it, 'eval');
+  %   y = net.predict(single(data_nchw));  % (batch,channel,y,x)
+
+  properties (Hidden)
+    handle
+  end
+
+  methods
+    function obj = Net(dev, cfg)
+      obj.handle = calllib('cxxnet_capi', 'CXNNetCreate', dev, cfg);
+      assert(~isNull(obj.handle), 'CXNNetCreate failed');
+    end
+
+    function delete(obj)
+      if ~isempty(obj.handle)
+        calllib('cxxnet_capi', 'CXNNetFree', obj.handle);
+      end
+    end
+
+    function set_param(obj, name, val)
+      calllib('cxxnet_capi', 'CXNNetSetParam', obj.handle, name, ...
+              num2str(val));
+    end
+
+    function init_model(obj)
+      calllib('cxxnet_capi', 'CXNNetInitModel', obj.handle);
+    end
+
+    function save_model(obj, fname)
+      calllib('cxxnet_capi', 'CXNNetSaveModel', obj.handle, fname);
+    end
+
+    function load_model(obj, fname)
+      calllib('cxxnet_capi', 'CXNNetLoadModel', obj.handle, fname);
+    end
+
+    function start_round(obj, r)
+      calllib('cxxnet_capi', 'CXNNetStartRound', obj.handle, int32(r));
+    end
+
+    function update_iter(obj, it)
+      calllib('cxxnet_capi', 'CXNNetUpdateIter', obj.handle, it.handle);
+    end
+
+    function update_batch(obj, data, label)
+      % data: single (batch,channel,y,x); label: single (batch,width)
+      dshape = uint32(size4(data));
+      lshape = uint32(size(label));
+      calllib('cxxnet_capi', 'CXNNetUpdateBatch', obj.handle, ...
+              single(permute(data, ndims(data):-1:1)), dshape, ...
+              single(label'), lshape);
+    end
+
+    function s = evaluate(obj, it, name)
+      s = calllib('cxxnet_capi', 'CXNNetEvaluate', obj.handle, ...
+                  it.handle, name);
+    end
+
+    function y = predict_iter(obj, it)
+      olen = libpointer('uint32Ptr', uint32(0));
+      p = calllib('cxxnet_capi', 'CXNNetPredictIter', obj.handle, ...
+                  it.handle, olen);
+      setdatatype(p, 'singlePtr', 1, double(olen.Value));
+      y = p.Value(:);
+    end
+
+    function w = get_weight(obj, layer, tag)
+      shp = libpointer('uint32Ptr', zeros(1, 4, 'uint32'));
+      nd = libpointer('uint32Ptr', uint32(0));
+      p = calllib('cxxnet_capi', 'CXNNetGetWeight', obj.handle, layer, ...
+                  tag, shp, nd);
+      if double(nd.Value) == 0
+        w = [];
+        return
+      end
+      dims = double(shp.Value(1:double(nd.Value)));
+      setdatatype(p, 'singlePtr', 1, prod(dims));
+      % C row-major -> MATLAB column-major
+      w = permute(reshape(p.Value, fliplr(dims)), numel(dims):-1:1);
+    end
+
+    function set_weight(obj, w, layer, tag)
+      wf = single(permute(w, ndims(w):-1:1));
+      calllib('cxxnet_capi', 'CXNNetSetWeight', obj.handle, wf(:), ...
+              uint32(numel(wf)), layer, tag);
+    end
+  end
+end
+
+function s = size4(x)
+  s = ones(1, 4);
+  s(1:ndims(x)) = size(x);
+end

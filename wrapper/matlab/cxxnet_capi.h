@@ -1,0 +1,63 @@
+/*
+ * C header of the cxxnet_tpu C ABI for MATLAB's loadlibrary (and any other
+ * C host). Mirrors the reference wrapper API (wrapper/cxxnet_wrapper.h:36-232)
+ * and is implemented by cxxnet_tpu/native/libcxxnet_capi.so (embedded-
+ * interpreter shim over the Python trainer).
+ */
+#ifndef CXXNET_CAPI_H_
+#define CXXNET_CAPI_H_
+
+typedef float cxx_real_t;
+typedef unsigned int cxx_uint;
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ---- data iterator handles ---- */
+void *CXNIOCreateFromConfig(const char *cfg);
+int CXNIONext(void *handle);
+void CXNIOBeforeFirst(void *handle);
+const cxx_real_t *CXNIOGetData(void *handle, cxx_uint oshape[4],
+                               cxx_uint *ostride);
+const cxx_real_t *CXNIOGetLabel(void *handle, cxx_uint oshape[2],
+                                cxx_uint *ostride);
+void CXNIOFree(void *handle);
+
+/* ---- net handles ---- */
+void *CXNNetCreate(const char *device, const char *cfg);
+void CXNNetFree(void *handle);
+void CXNNetSetParam(void *handle, const char *name, const char *val);
+void CXNNetInitModel(void *handle);
+void CXNNetSaveModel(void *handle, const char *fname);
+void CXNNetLoadModel(void *handle, const char *fname);
+void CXNNetStartRound(void *handle, int round);
+void CXNNetSetWeight(void *handle, cxx_real_t *p_weight,
+                     cxx_uint size_weight, const char *layer_name,
+                     const char *wtag);
+const cxx_real_t *CXNNetGetWeight(void *handle, const char *layer_name,
+                                  const char *wtag, cxx_uint wshape[4],
+                                  cxx_uint *out_dim);
+void CXNNetUpdateIter(void *handle, void *data_handle);
+void CXNNetUpdateBatch(void *handle, cxx_real_t *p_data,
+                       const cxx_uint dshape[4], cxx_real_t *p_label,
+                       const cxx_uint lshape[2]);
+const cxx_real_t *CXNNetPredictBatch(void *handle, cxx_real_t *p_data,
+                                     const cxx_uint dshape[4],
+                                     cxx_uint *out_size);
+const cxx_real_t *CXNNetPredictIter(void *handle, void *data_handle,
+                                    cxx_uint *out_size);
+const cxx_real_t *CXNNetExtractBatch(void *handle, cxx_real_t *p_data,
+                                     const cxx_uint dshape[4],
+                                     const char *node_name,
+                                     cxx_uint oshape[4]);
+const cxx_real_t *CXNNetExtractIter(void *handle, void *data_handle,
+                                    const char *node_name,
+                                    cxx_uint oshape[4]);
+const char *CXNNetEvaluate(void *handle, void *data_handle,
+                           const char *data_name);
+
+#ifdef __cplusplus
+}
+#endif
+#endif  /* CXXNET_CAPI_H_ */

@@ -1,0 +1,15 @@
+function cxxnet_load(libdir)
+% cxxnet_load: load libcxxnet_capi once per MATLAB session.
+%   cxxnet_load()          % library next to the repo's native build
+%   cxxnet_load('/path')   % explicit directory
+% Build the library first: sh cxxnet_tpu/native/build.sh
+if libisloaded('cxxnet_capi')
+  return
+end
+here = fileparts(mfilename('fullpath'));
+if nargin < 1
+  libdir = fullfile(here, '..', '..', 'cxxnet_tpu', 'native');
+end
+loadlibrary(fullfile(libdir, 'libcxxnet_capi.so'), ...
+            fullfile(here, 'cxxnet_capi.h'), 'alias', 'cxxnet_capi');
+end
