@@ -41,6 +41,7 @@ KNOWN_LAYER_TYPES = {
     # sequence/transformer extensions (no reference analog; SURVEY §5
     # long-context is N/A there — first-class here)
     "embed", "layernorm", "mha", "ffn", "seqfc", "add", "lmloss", "moe",
+    "posembed",
 }
 
 

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.attention import (attention_reference, chunked_attention,
-                             flash_attention)
+                             flash_attention, rope)
 from .base import Layer, Shape3, register_layer
 from .loss import LossLayerBase
 
@@ -107,6 +107,27 @@ class LayerNormLayer(Layer):
         return [y.astype(ctx.compute_dtype)], state
 
 
+@register_layer("posembed")
+class PosEmbedLayer(Layer):
+    """Learned absolute position embedding added to a sequence node
+    (E,S,1) -> (E,S,1). Alternative to rotary (``rope = 1`` on mha)."""
+    has_params = True
+
+    def infer_shapes(self, in_shapes):
+        self.check_n(in_shapes, 1, 1)
+        return [in_shapes[0]]
+
+    def init_params(self, key, in_shapes):
+        e, s, _ = in_shapes[0]
+        return {"wmat": self.hp.init_sigma *
+                jax.random.normal(key, (s, e), jnp.float32)}
+
+    def apply(self, params, state, inputs, ctx):
+        x = inputs[0]
+        pe = params["wmat"].astype(ctx.compute_dtype)
+        return [x + pe.reshape(1, pe.shape[0], 1, pe.shape[1])], state
+
+
 class _SeqLinearMixin:
     """Shared init for (in_dim -> out_dim) projections on sequence nodes."""
 
@@ -142,12 +163,18 @@ class MultiHeadAttentionLayer(Layer, _SeqLinearMixin):
             self.attn_impl = val
         elif name == "attn_block":
             self.attn_block = int(val)
+        elif name == "rope":
+            self.rope = bool(int(val))
+        elif name == "rope_theta":
+            self.rope_theta = float(val)
 
     def __init__(self, spec, global_cfg):
         self.nhead = 8
         self.causal = False
         self.attn_impl = "auto"
         self.attn_block = 128
+        self.rope = False
+        self.rope_theta = 10000.0
         super().__init__(spec, global_cfg)
 
     def infer_shapes(self, in_shapes):
@@ -212,6 +239,8 @@ class MultiHeadAttentionLayer(Layer, _SeqLinearMixin):
             return out
 
         q, k, v = proj("q"), proj("k"), proj("v")
+        if self.rope:
+            q, k = rope(q, self.rope_theta), rope(k, self.rope_theta)
         o = self._attend(q, k, v, ctx)
         wo = params["o"]["wmat"].astype(ctx.compute_dtype)
         y = jnp.einsum("bshd,hde->bse", o, wo)

@@ -173,6 +173,7 @@ class LearnTask:
             final = ckpt.model_path(self.model_dir, self.num_round - 1)
             if not os.path.exists(final):
                 tr.save_model(final)
+        tr.wait_saves()       # drain async checkpoint writes before exit
 
     def _train_rounds(self, tr, itr_train, evals) -> None:
         start = time.time()

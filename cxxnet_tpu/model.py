@@ -47,6 +47,11 @@ class Network:
         self.compute_dtype = {"float32": jnp.float32,
                               "bfloat16": jnp.bfloat16,
                               "bf16": jnp.bfloat16}[cdt]
+        # remat = 1: rematerialize each layer's activations in the backward
+        # pass (jax.checkpoint) — trades FLOPs for HBM, the standard TPU
+        # recipe for memory-bound models (no reference analog; the closest
+        # is temp_col_max's memory/compute staging, SURVEY §5)
+        self.remat = bool(int(global_param(cfg, "remat", "0")))
         # build layer objects; shared specs reuse the primary object
         self.layers: List[Layer] = []
         for spec in graph.layers:
@@ -129,7 +134,15 @@ class Network:
             inputs = [nodes[ni] for ni in spec.nindex_in]
             lparams = params.get(layer.name, {})
             lstate = new_state.get(layer.name, {})
-            outputs, lstate_out = layer.apply(lparams, lstate, inputs, ctx)
+            if self.remat and layer.has_params:
+                def _fn(lp, ls, rng_, *ins, _layer=layer, _ctx=ctx):
+                    c = ApplyCtx(train=_ctx.train, rng=rng_,
+                                 compute_dtype=_ctx.compute_dtype)
+                    return _layer.apply(lp, ls, list(ins), c)
+                outputs, lstate_out = jax.checkpoint(_fn)(
+                    lparams, lstate, ctx.rng, *inputs)
+            else:
+                outputs, lstate_out = layer.apply(lparams, lstate, inputs, ctx)
             if lstate_out:
                 new_state[layer.name] = lstate_out
                 # auxiliary regularizers (e.g. MoE load-balancing loss)

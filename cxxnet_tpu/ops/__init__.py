@@ -11,10 +11,12 @@ from .attention import (
     attention_reference,
     chunked_attention,
     flash_attention,
+    rope,
 )
 
 __all__ = [
     "attention_reference",
     "chunked_attention",
     "flash_attention",
+    "rope",
 ]

@@ -39,6 +39,26 @@ def _scale(q: jax.Array, scale: Optional[float]) -> float:
     return (q.shape[-1] ** -0.5) if scale is None else scale
 
 
+def rope(x: jax.Array, theta: float = 10000.0,
+         offset: int = 0) -> jax.Array:
+    """Rotary position embedding on (B, S, H, D) (D even): rotates feature
+    pairs by position-dependent angles, encoding relative positions
+    directly in the q/k dot products. ``offset`` shifts the position base
+    (for sequence-sharded shards)."""
+    B, S, H, D = x.shape
+    if D % 2:
+        raise ValueError(f"rope needs an even head_dim, got {D}")
+    pos = jnp.arange(offset, offset + S, dtype=jnp.float32)
+    inv = theta ** (-jnp.arange(0, D // 2, dtype=jnp.float32) / (D // 2))
+    ang = pos[:, None] * inv[None, :]                 # (S, D/2)
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    x1, x2 = x[..., : D // 2], x[..., D // 2:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                          axis=-1)
+    return out.astype(x.dtype)
+
+
 def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
                         causal: bool = False,
                         scale: Optional[float] = None) -> jax.Array:

@@ -51,6 +51,11 @@ class Trainer:
         self.eval_train = int(gp("eval_train", "1"))
         self.seed = int(gp("seed", "0"))
         self.silent = int(gp("silent", "0"))
+        # save_async = 1: checkpoint file IO happens on a background thread
+        # (device->host gather stays synchronous); training resumes while
+        # the previous checkpoint is still being written
+        self.save_async = int(gp("save_async", "0"))
+        self._save_thread = None
         dev = gp("dev", "")
         model_parallel = int(gp("model_parallel", "1"))
         self.mesh = mesh_ctx or make_mesh_context(dev or "tpu",
@@ -124,12 +129,45 @@ class Trainer:
         opt = self.mesh.gather(self.opt_state)
         if jax.process_index() != 0:
             return
-        ckpt.save_model(
-            path, structure_sig=self.graph.structure_signature(),
+        kwargs = dict(
+            structure_sig=self.graph.structure_signature(),
             round_counter=self.round_counter, epoch_counter=self.epoch_counter,
             params=params, net_state=self.net_state, opt_state=opt)
+        if not self.save_async:
+            ckpt.save_model(path, **kwargs)
+            return
+        # host copies of EVERY device tree before handing off: the jitted
+        # train step donates params/opt_state/net_state, so the next
+        # update() would delete the buffers under the writer thread
+        kwargs["params"] = ckpt.jax_to_numpy(params)
+        kwargs["opt_state"] = ckpt.jax_to_numpy(opt)
+        kwargs["net_state"] = ckpt.jax_to_numpy(self.net_state)
+        self.wait_saves()
+        import threading
+        err: List[BaseException] = []
+
+        def _write():
+            try:
+                ckpt.save_model(path, **kwargs)
+            except BaseException as e:      # surfaced by wait_saves()
+                err.append(e)
+
+        t = threading.Thread(target=_write, daemon=True)
+        t.start()
+        self._save_thread = (t, err)
+
+    def wait_saves(self) -> None:
+        """Join any in-flight async checkpoint write; re-raise its error
+        (a silently missing checkpoint must not look like success)."""
+        if self._save_thread is not None:
+            t, err = self._save_thread
+            t.join()
+            self._save_thread = None
+            if err:
+                raise RuntimeError("async checkpoint write failed") from err[0]
 
     def load_model(self, path: str) -> None:
+        self.wait_saves()     # never read a checkpoint mid-write
         blob = ckpt.load_model(path)
         ckpt.check_structure(blob["meta"], self.graph.structure_signature())
         opt = blob["opt"] if blob["opt"] is not None \

@@ -306,3 +306,49 @@ metric = error
     rep = tr.train_metric_report()
     err = float(rep.split(":")[-1])
     assert err < 0.2, rep
+
+
+def test_async_checkpoint_roundtrip(tmp_path, mesh8):
+    """save_async=1: background-thread checkpoint writes are complete and
+    loadable, including back-to-back saves."""
+    tr = make_trainer(mesh8, extra="save_async = 1\n")
+    itr = synth_iter()
+    for batch in itr:
+        tr.update(batch)
+        break
+    p1, p2 = str(tmp_path / "a.model"), str(tmp_path / "b.model")
+    tr.save_model(p1)
+    tr.save_model(p2)          # must join the in-flight write first
+    tr.wait_saves()
+    tr2 = make_trainer(mesh8)
+    tr2.load_model(p2)
+    np.testing.assert_allclose(tr2.get_weight("fc1", "wmat"),
+                               tr.get_weight("fc1", "wmat"))
+
+
+def test_async_checkpoint_with_stateful_net(tmp_path, mesh8):
+    """Donation hazard regression: async save of a net WITH state (BN
+    running stats) while training continues must still write a complete,
+    loadable checkpoint."""
+    bn_cfg = MLP_CFG.replace("layer[+1:a1] = relu",
+                             "layer[+0] = batch_norm:bn1\nlayer[+1:a1] = relu")
+    cfg = parse_config_string(bn_cfg + "save_async = 1\n")
+    tr = Trainer(cfg, mesh_ctx=mesh8)
+    tr.init_model()
+    itr = synth_iter()
+    batches = list(itr)
+    tr.update(batches[0])
+    p = str(tmp_path / "s.model")
+    tr.save_model(p)
+    tr.update(batches[1])      # donates the old state mid-write
+    tr.wait_saves()            # raises if the writer hit deleted buffers
+    tr2 = Trainer(parse_config_string(bn_cfg), mesh_ctx=mesh8)
+    tr2.init_model()
+    tr2.load_model(p)
+
+
+def test_async_checkpoint_error_surfaces(tmp_path, mesh8):
+    tr = make_trainer(mesh8, extra="save_async = 1\n")
+    tr.save_model(str(tmp_path / "no_such_dir" / "x.model"))
+    with pytest.raises(RuntimeError, match="async checkpoint"):
+        tr.wait_saves()
