@@ -91,6 +91,12 @@ class Trainer:
         self._train_step_fns: Dict[bool, Any] = {}
         self._eval_step_fn = None
         self._last_loss = None
+        # one-step deferred train-metric fetch: device->host reads of step
+        # N's outputs happen after step N+1 is dispatched, so the transfer
+        # overlaps compute instead of syncing every update (the reference
+        # accumulates metrics only after WaitAllJobs; XLA async dispatch
+        # makes the lagged fetch free)
+        self._pending_metric = None
         if self.batch_size % self.mesh.data_parallel:
             raise ValueError(
                 f"batch_size {self.batch_size} not divisible by data-parallel "
@@ -295,7 +301,8 @@ class Trainer:
             self.sample_counter = 0
             self.epoch_counter += 1
         if self.eval_train:
-            self._add_metric(self.train_metric, nodes, batch)
+            self._drain_pending_metric()
+            self._pending_metric = (nodes, batch)
 
     def _mask(self, batch: DataBatch):
         mask = np.ones((batch.batch_size,), np.float32)
@@ -392,7 +399,14 @@ class Trainer:
             out += "\t%s:%f" % (mname, val)
         return out
 
+    def _drain_pending_metric(self) -> None:
+        if self._pending_metric is not None:
+            nodes, batch = self._pending_metric
+            self._pending_metric = None
+            self._add_metric(self.train_metric, nodes, batch)
+
     def train_metric_report(self, name: str = "train") -> str:
+        self._drain_pending_metric()
         if jax.process_count() > 1:   # same global reduction as evaluate()
             from .parallel import allreduce_metric_pairs
             self.train_metric.set_pairs(
