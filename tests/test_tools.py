@@ -197,3 +197,30 @@ def test_two_process_distributed_training(tmp_path):
     # rank-0-only checkpointing: exactly the two round files, once each
     assert sorted(f for f in os.listdir(tmp_path)
                   if f.endswith(".model")) == ["0000.model", "0001.model"]
+
+
+def test_two_process_ring_attention(tmp_path):
+    """Sequence parallelism across process boundaries: the 'seq' mesh axis
+    spans 2 processes x 2 devices; ppermute carries k/v shards over the
+    inter-process transport and every rank's local output must match the
+    single-device reference."""
+    import socket
+    with socket.socket() as s:        # reserve a genuinely free port
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    cwd = os.path.join(REPO, "examples", "multi-machine")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "CXXNET_CPU_DEVICES": "2"}
+    procs = [subprocess.Popen(
+        [sys.executable, "ring_worker.py", f"localhost:{port}", "2", str(r)],
+        cwd=cwd, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True) for r in range(2)]
+    try:
+        outs = [p.communicate(timeout=240) for p in procs]
+    finally:                          # no orphan workers on timeout/failure
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    assert all(p.returncode == 0 for p in procs), \
+        [o[1][-2000:] for o in outs]
+    assert "ring-attention x2proc causal=True ok" in outs[0][0]
