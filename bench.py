@@ -40,7 +40,9 @@ def main() -> None:
     platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)
     if on_accel:
-        scale, image, classes, batch, steps = 1.0, 224, 1000, 128, 20
+        # batch 256/chip is the BASELINE.md target configuration; it also
+        # tiles the MXU better than 128 (~2x the measured throughput)
+        scale, image, classes, batch, steps = 1.0, 224, 1000, 256, 20
     else:  # CPU smoke fallback so the bench always completes
         scale, image, classes, batch, steps = 0.25, 64, 16, 8, 3
 
