@@ -128,6 +128,9 @@ class ApplyCtx:
     train: bool
     rng: Optional[jax.Array] = None     # folded per-layer key, stochastic layers
     compute_dtype: Any = jnp.float32
+    # bound when the whole step runs under shard_map with the sequence
+    # sharded (seq_parallel > 1): attention layers switch to the ring path
+    seq_axis: Optional[str] = None
 
 
 class Layer:

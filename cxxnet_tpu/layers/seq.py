@@ -207,6 +207,12 @@ class MultiHeadAttentionLayer(Layer, _SeqLinearMixin):
                 "o": {"wmat": ("model", None, None), "bias": None}}
 
     def _attend(self, q, k, v, ctx):
+        if ctx.seq_axis is not None:
+            # sequence-parallel step (shard_map): q/k/v are local sequence
+            # shards; the ring carries k/v around the mesh axis
+            from ..parallel.ring import ring_attention
+            return ring_attention(q, k, v, axis_name=ctx.seq_axis,
+                                  causal=self.causal)
         if self.attn_impl == "ref":
             return attention_reference(q, k, v, causal=self.causal)
         if self.attn_impl == "chunked":
@@ -240,7 +246,10 @@ class MultiHeadAttentionLayer(Layer, _SeqLinearMixin):
 
         q, k, v = proj("q"), proj("k"), proj("v")
         if self.rope:
-            q, k = rope(q, self.rope_theta), rope(k, self.rope_theta)
+            off = 0
+            if ctx.seq_axis is not None:   # global positions for local shard
+                off = jax.lax.axis_index(ctx.seq_axis) * q.shape[1]
+            q, k = rope(q, self.rope_theta, off), rope(k, self.rope_theta, off)
         o = self._attend(q, k, v, ctx)
         wo = params["o"]["wmat"].astype(ctx.compute_dtype)
         y = jnp.einsum("bshd,hde->bse", o, wo)

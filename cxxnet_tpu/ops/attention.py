@@ -40,15 +40,16 @@ def _scale(q: jax.Array, scale: Optional[float]) -> float:
 
 
 def rope(x: jax.Array, theta: float = 10000.0,
-         offset: int = 0) -> jax.Array:
+         offset=0) -> jax.Array:
     """Rotary position embedding on (B, S, H, D) (D even): rotates feature
     pairs by position-dependent angles, encoding relative positions
     directly in the q/k dot products. ``offset`` shifts the position base
-    (for sequence-sharded shards)."""
+    (for sequence-sharded shards; may be a traced scalar, e.g.
+    lax.axis_index under shard_map)."""
     B, S, H, D = x.shape
     if D % 2:
         raise ValueError(f"rope needs an even head_dim, got {D}")
-    pos = jnp.arange(offset, offset + S, dtype=jnp.float32)
+    pos = jnp.arange(S, dtype=jnp.float32) + jnp.asarray(offset, jnp.float32)
     inv = theta ** (-jnp.arange(0, D // 2, dtype=jnp.float32) / (D // 2))
     ang = pos[:, None] * inv[None, :]                 # (S, D/2)
     cos = jnp.cos(ang)[None, :, None, :]

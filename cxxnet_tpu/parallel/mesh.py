@@ -53,6 +53,7 @@ class MeshContext:
     mesh: Mesh
     data_axis: str = "data"
     model_axis: str = "model"
+    seq_axis: str = "seq"
 
     @property
     def num_devices(self) -> int:
@@ -61,6 +62,10 @@ class MeshContext:
     @property
     def data_parallel(self) -> int:
         return self.mesh.shape[self.data_axis]
+
+    @property
+    def seq_parallel(self) -> int:
+        return self.mesh.shape.get(self.seq_axis, 1)
 
     # -- shardings ---------------------------------------------------------
     def replicated(self) -> NamedSharding:
@@ -184,17 +189,22 @@ def allreduce_metric_pairs(pairs):
 
 def make_mesh_context(dev: str = "tpu",
                       devices: Optional[Sequence] = None,
-                      model_parallel: int = 1) -> MeshContext:
+                      model_parallel: int = 1,
+                      seq_parallel: int = 1) -> MeshContext:
     """Build the mesh. ``dev`` is the config device spec; ``devices``
-    overrides explicitly (used by tests to build CPU meshes)."""
+    overrides explicitly (used by tests to build CPU meshes). Axes:
+    ``('data', 'seq', 'model')`` — seq/model default to size 1 so pure
+    data-parallel code is unaffected."""
     if devices is None:
         idx = parse_device_spec(dev)
         all_devs = jax.devices()
         devices = all_devs if idx is None else [all_devs[i] for i in idx]
     n = len(devices)
-    if n % model_parallel:
+    if n % (model_parallel * seq_parallel):
         raise ValueError(
-            f"{n} devices not divisible by model_parallel={model_parallel}")
-    arr = np.asarray(devices).reshape(n // model_parallel, model_parallel)
-    mesh = Mesh(arr, ("data", "model"))
+            f"{n} devices not divisible by model_parallel={model_parallel} "
+            f"x seq_parallel={seq_parallel}")
+    arr = np.asarray(devices).reshape(
+        n // (model_parallel * seq_parallel), seq_parallel, model_parallel)
+    mesh = Mesh(arr, ("data", "seq", "model"))
     return MeshContext(mesh=mesh)

@@ -54,17 +54,13 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
         return (acc, m, l, k_nxt, v_nxt), None
 
-    # constants must be marked device-varying before entering the scan carry
-    # (shard_map's varying-manual-axes check)
-    def pvary(x):
-        try:
-            return lax.pcast(x, (axis_name,), to="varying")
-        except (AttributeError, TypeError):  # older jax spelling
-            return lax.pvary(x, (axis_name,))
-
-    acc0 = pvary(jnp.zeros((B, H, S_loc, D), jnp.float32))
-    m0 = pvary(jnp.full((B, H, S_loc), -1e30, jnp.float32))
-    l0 = pvary(jnp.zeros((B, H, S_loc), jnp.float32))
+    # the scan carry must be device-varying over every mesh axis the
+    # inputs vary over (not just the ring axis — an enclosing shard_map may
+    # add e.g. a 'data' axis); deriving the init values from q makes them
+    # inherit exactly the right varying axes
+    acc0 = jnp.zeros_like(q, shape=(B, H, S_loc, D), dtype=jnp.float32)
+    m0 = jnp.full_like(q, -1e30, shape=(B, H, S_loc), dtype=jnp.float32)
+    l0 = jnp.zeros_like(q, shape=(B, H, S_loc), dtype=jnp.float32)
     (acc, m, l, _, _), _ = lax.scan(
         step, (acc0, m0, l0, k, v), jnp.arange(n))
     out = acc / jnp.maximum(l, 1e-30)[..., None]

@@ -40,6 +40,21 @@ _METRIC_RE = re.compile(r"^metric(?:\[([^,\]]+)(?:,([^\]]+))?\])?$")
 _TOP = "!top"
 
 
+def _apply_grads(opt, period, do_update, params, opt_state, accum, grads,
+                 sched):
+    """Gradient accumulation (update_period) + optimizer step — shared by
+    the GSPMD and shard_map train-step builders."""
+    if period > 1:
+        accum = jax.tree_util.tree_map(jnp.add, accum, grads)
+        if do_update:
+            scaled = jax.tree_util.tree_map(lambda g: g / period, accum)
+            params, opt_state = opt.update(params, scaled, opt_state, sched)
+            accum = jax.tree_util.tree_map(jnp.zeros_like, accum)
+    else:
+        params, opt_state = opt.update(params, grads, opt_state, sched)
+    return params, opt_state, accum
+
+
 class Trainer:
     def __init__(self, cfg: ConfigPairs, mesh_ctx: Optional[MeshContext] = None):
         self.cfg = list(cfg)
@@ -58,8 +73,11 @@ class Trainer:
         self._save_thread = None
         dev = gp("dev", "")
         model_parallel = int(gp("model_parallel", "1"))
-        self.mesh = mesh_ctx or make_mesh_context(dev or "tpu",
-                                                  model_parallel=model_parallel)
+        seq_parallel = int(gp("seq_parallel", "1"))
+        self.mesh = mesh_ctx or make_mesh_context(
+            dev or "tpu", model_parallel=model_parallel,
+            seq_parallel=seq_parallel)
+        self._sp = self.mesh.seq_parallel
         self.optimizer = create_optimizer(self.graph.updater_type, cfg)
         # metric bindings (reference nnet_impl-inl.hpp:73-83)
         self.metric = MetricSet()
@@ -101,6 +119,49 @@ class Trainer:
             raise ValueError(
                 f"batch_size {self.batch_size} not divisible by data-parallel "
                 f"degree {self.mesh.data_parallel}")
+        if self._sp > 1:
+            self._check_seq_parallel_ok()
+
+    # Layers whose apply is correct on a local sequence shard under
+    # shard_map (mha switches to the ring path via ctx.seq_axis). posembed
+    # is excluded: its absolute table indexes global positions.
+    _SP_SAFE_LAYERS = frozenset({
+        "embed", "layernorm", "mha", "ffn", "seqfc", "add", "lmloss",
+        "moe", "relu", "sigmoid", "tanh", "softplus", "dropout", "share"})
+
+    def _check_seq_parallel_ok(self) -> None:
+        """seq_parallel (ring attention inside the config-driven step) is
+        supported for pure sequence models; fail fast otherwise."""
+        bad = [s.type for s in self.graph.layers
+               if s.type not in self._SP_SAFE_LAYERS]
+        if bad:
+            raise ValueError(
+                f"seq_parallel: layer types {sorted(set(bad))} are not "
+                f"sequence-shardable (use rope for positions, not posembed)")
+        if self.mesh.mesh.shape[self.mesh.model_axis] != 1:
+            raise ValueError("seq_parallel with model_parallel>1 is not "
+                             "supported yet")
+        if self.graph.extra_data_num:
+            raise ValueError("seq_parallel does not support extra_data")
+        c, y, S = self.graph.input_shape
+        if (c, y) != (1, 1) or S % self._sp:
+            raise ValueError(
+                f"seq_parallel: input must be a flat (1,1,S) token node "
+                f"with S divisible by {self._sp}, got {(c, y, S)}")
+        if self.graph.label_width() % self._sp:
+            raise ValueError(
+                f"seq_parallel: label width {self.graph.label_width()} not "
+                f"divisible by {self._sp}")
+        # the label shards along its width, but loss layers slice it with
+        # global label_vec indices — only a single full-width slice maps
+        # cleanly onto shards
+        if self.graph.label_range != [(0, self.graph.label_width())]:
+            raise ValueError(
+                "seq_parallel requires a single full-width label slice "
+                f"(got label_vec ranges {self.graph.label_range})")
+        if any(n is not None for n in self._metric_nodes):
+            raise ValueError(
+                "seq_parallel supports metrics on the top node only")
 
     # -- model lifecycle ---------------------------------------------------
     def _place(self, params, net_state=None, opt_state=None):
@@ -238,6 +299,58 @@ class Trainer:
     def _needed_nodes(self) -> List[str]:
         return sorted({n for n in self._metric_nodes if n is not None})
 
+    def _shard_seq_batch(self, data, label=None):
+        """Place batch arrays with the sequence axis sharded (token inputs
+        (b,1,1,S) and (b,S)-wide labels)."""
+        from jax.sharding import PartitionSpec as P
+        out = [jax.device_put(data, self.mesh.named(
+            P(self.mesh.data_axis, None, None, self.mesh.seq_axis)))]
+        if label is not None:
+            out.append(jax.device_put(label, self.mesh.named(
+                P(self.mesh.data_axis, self.mesh.seq_axis))))
+        return out if len(out) != 1 else out[0]
+
+    def _make_sp_train_step(self, do_update: bool):
+        """Sequence-parallel train step: the whole step body runs under
+        shard_map over the ('data','seq') mesh; mha layers take the ring
+        path, gradients of replicated params are psum'd automatically by
+        shard_map's transpose, and the loss is averaged across shards.
+        Note: the per-layer RNG is replicated, so dropout masks repeat
+        across sequence shards (documented limitation)."""
+        from jax.sharding import PartitionSpec as P
+        net, opt, period = self.net, self.optimizer, self.update_period
+        seq_axis, data_axis = self.mesh.seq_axis, self.mesh.data_axis
+        rep = P()
+
+        def step(params, opt_state, net_state, accum, data, label, mask,
+                 rng, sched):
+            def loss_fn(p):
+                res = net.apply(p, net_state, data, label, mask, rng=rng,
+                                train=True, seq_axis=seq_axis)
+                loss = jax.lax.pmean(
+                    jax.lax.pmean(res.loss, seq_axis), data_axis)
+                return loss, (res.state, res.out)
+            (loss, (new_state, top)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            # layer state computed from local shards (e.g. the MoE
+            # load-balance aux loss) must leave the shard_map replicated
+            new_state = jax.tree_util.tree_map(
+                lambda x: jax.lax.pmean(
+                    jax.lax.pmean(x, seq_axis), data_axis), new_state)
+            params, opt_state, accum = _apply_grads(
+                opt, period, do_update, params, opt_state, accum, grads,
+                sched)
+            return params, opt_state, new_state, accum, loss, top
+
+        top_spec = P(data_axis, seq_axis, None, None)
+        wrapped = jax.shard_map(
+            step, mesh=self.mesh.mesh,
+            in_specs=(rep, rep, rep, rep,
+                      P(data_axis, None, None, seq_axis),
+                      P(data_axis, seq_axis), P(data_axis), rep, rep),
+            out_specs=(rep, rep, rep, rep, rep, top_spec))
+        return jax.jit(wrapped, donate_argnums=(0, 1, 2, 3))
+
     def _make_train_step(self, do_update: bool):
         net, opt, period = self.net, self.optimizer, self.update_period
         needed = self._needed_nodes()
@@ -255,16 +368,9 @@ class Trainer:
                 return res.loss, (res.state, nodes)
             (loss, (new_state, nodes)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
-            if period > 1:
-                accum = jax.tree_util.tree_map(jnp.add, accum, grads)
-                if do_update:
-                    scaled = jax.tree_util.tree_map(
-                        lambda g: g / period, accum)
-                    params, opt_state = opt.update(params, scaled, opt_state,
-                                                   sched)
-                    accum = jax.tree_util.tree_map(jnp.zeros_like, accum)
-            else:
-                params, opt_state = opt.update(params, grads, opt_state, sched)
+            params, opt_state, accum = _apply_grads(
+                opt, period, do_update, params, opt_state, accum, grads,
+                sched)
             return params, opt_state, new_state, accum, loss, nodes
 
         return jax.jit(step, donate_argnums=(0, 1, 2, 3))
@@ -280,18 +386,29 @@ class Trainer:
         assert self.params is not None, "call init_model() first"
         do_update = (self.sample_counter + 1) % self.update_period == 0 \
             if self.update_period > 1 else True
-        key = do_update
+        key = (do_update, self._sp > 1)
         if key not in self._train_step_fns:
-            self._train_step_fns[key] = self._make_train_step(do_update)
+            self._train_step_fns[key] = (
+                self._make_sp_train_step(do_update) if self._sp > 1
+                else self._make_train_step(do_update))
         step = self._train_step_fns[key]
-        data, label = self.mesh.shard_batch(batch.data, batch.label)
         mask = self._mask(batch)
-        extra = tuple(self.mesh.shard_batch(e) for e in batch.extra_data)
         rng = jax.random.fold_in(self._base_key, self._step_count)
         accum_in = self.accum if self.update_period > 1 else {}
-        (self.params, self.opt_state, self.net_state, accum, loss,
-         nodes) = step(self.params, self.opt_state, self.net_state, accum_in,
-                       data, label, mask, extra, rng, self._sched_scalars())
+        if self._sp > 1:
+            data, label = self._shard_seq_batch(batch.data, batch.label)
+            (self.params, self.opt_state, self.net_state, accum, loss,
+             top) = step(self.params, self.opt_state, self.net_state,
+                         accum_in, data, label, mask, rng,
+                         self._sched_scalars())
+            nodes = {_TOP: top}
+        else:
+            data, label = self.mesh.shard_batch(batch.data, batch.label)
+            extra = tuple(self.mesh.shard_batch(e) for e in batch.extra_data)
+            (self.params, self.opt_state, self.net_state, accum, loss,
+             nodes) = step(self.params, self.opt_state, self.net_state,
+                           accum_in, data, label, mask, extra, rng,
+                           self._sched_scalars())
         if self.update_period > 1:
             self.accum = accum
         self._last_loss = loss
@@ -372,8 +489,35 @@ class Trainer:
 
         return jax.jit(step)
 
+    def _make_sp_eval_step(self):
+        """Sequence-parallel inference: shard_map over ('data','seq'),
+        ring attention inside; top node only (guarded at init)."""
+        from jax.sharding import PartitionSpec as P
+        net = self.net
+        seq_axis, data_axis = self.mesh.seq_axis, self.mesh.data_axis
+
+        def step(params, net_state, data):
+            res = net.apply(params, net_state, data, train=False,
+                            seq_axis=seq_axis)
+            return res.out
+
+        wrapped = jax.shard_map(
+            step, mesh=self.mesh.mesh,
+            in_specs=(P(), P(), P(data_axis, None, None, seq_axis)),
+            out_specs=P(data_axis, seq_axis, None, None))
+        return jax.jit(wrapped)
+
     def _eval_nodes(self, batch: DataBatch,
                     extract: Tuple[str, ...] = ()) -> Dict[str, jax.Array]:
+        if self._sp > 1:
+            if extract:
+                raise ValueError(
+                    "seq_parallel supports extraction of the top node only")
+            if self._eval_step_fn is None or self._eval_step_fn[0] != "sp":
+                self._eval_step_fn = ("sp", self._make_sp_eval_step())
+            data = self._shard_seq_batch(batch.data)
+            return {_TOP: self._eval_step_fn[1](self.params, self.net_state,
+                                                data)}
         key = tuple(extract)
         if self._eval_step_fn is None or self._eval_step_fn[0] != key:
             self._eval_step_fn = (key, self._make_eval_step(extract))
