@@ -121,19 +121,38 @@ def find_latest(model_dir: str) -> Optional[Tuple[int, str]]:
     return best
 
 
+def _tree_matches(dst: Any, src: Any) -> bool:
+    """Leaf-wise structural+shape equality between two (possibly nested)
+    param trees. Layers like mha/moe/ffn hold sub-dicts of arrays, so a flat
+    ``np.shape(src[k]) == np.shape(v)`` check is vacuous for them."""
+    if isinstance(dst, dict):
+        return (isinstance(src, dict)
+                and set(src.keys()) >= set(dst.keys())
+                and all(_tree_matches(v, src[k]) for k, v in dst.items()))
+    if isinstance(src, dict):
+        return False
+    return np.shape(src) == np.shape(dst)
+
+
+def _tree_copy(dst: Any, src: Any) -> Any:
+    """Copy src leaves into dst's structure (dst keys only), as numpy."""
+    if isinstance(dst, dict):
+        return {k: _tree_copy(v, src[k]) for k, v in dst.items()}
+    return np.asarray(src)
+
+
 def copy_model_from(dst_params: Dict[str, Any], src_params: Dict[str, Any],
                     verbose: bool = True) -> Dict[str, Any]:
     """Name-matched layer copy for finetune (reference CopyModelFrom,
-    nnet_impl-inl.hpp:117-150): layers whose name and shapes match are copied;
-    everything else keeps its fresh initialization."""
+    nnet_impl-inl.hpp:117-150): layers whose name and all (possibly nested)
+    param leaf shapes match are copied; everything else keeps its fresh
+    initialization."""
     out = {}
     for lname, lp in dst_params.items():
         if lname in src_params:
             src = src_params[lname]
-            ok = all(k in src and np.shape(src[k]) == np.shape(v)
-                     for k, v in lp.items())
-            if ok:
-                out[lname] = {k: np.asarray(src[k]) for k in lp}
+            if _tree_matches(lp, src):
+                out[lname] = _tree_copy(lp, src)
                 if verbose:
                     print(f"CopyModelFrom: copied layer {lname!r}")
                 continue

@@ -112,11 +112,33 @@ class LearnTask:
                 return self._make_iter(pairs)
         return None
 
+    def _agree_latest(self):
+        """Resolve the continue=1 resume round, and in multi-host runs verify
+        every rank resolved the SAME round before anyone loads — ranks that
+        scan model_dir independently on non-shared disks would otherwise
+        issue mismatched collectives and hang. model_dir must live on a
+        filesystem visible to all ranks (doc/multichip.md)."""
+        latest = ckpt.find_latest(self.model_dir)
+        import jax
+        if jax.process_count() > 1:
+            import numpy as np
+            from jax.experimental import multihost_utils
+            local = -1 if latest is None else latest[0]
+            rounds = np.asarray(multihost_utils.process_allgather(
+                np.int32(local))).ravel()
+            if len(set(int(x) for x in rounds)) != 1:
+                raise RuntimeError(
+                    "continue=1: ranks resolved different latest checkpoint "
+                    f"rounds {sorted(set(int(x) for x in rounds))}; model_dir "
+                    "must be on a shared filesystem visible to every rank "
+                    "(see doc/multichip.md)")
+        return latest
+
     # -- model init --------------------------------------------------------
     def _init_model(self) -> None:
         tr = self.trainer
         if self.continue_training:
-            latest = ckpt.find_latest(self.model_dir)
+            latest = self._agree_latest()
             if latest is not None:
                 r, path = latest
                 tr.init_model()

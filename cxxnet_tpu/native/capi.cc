@@ -61,6 +61,13 @@ PyObject* Bridge() {
 // printed to stderr, mirroring the reference's utils::Error abort-free
 // wrapper behavior as closely as a C ABI allows).
 PyObject* Call(const char* fn, PyObject* args) {
+  if (args == nullptr) {
+    // Py_BuildValue/PyTuple_Pack failed at the call site; report that
+    // failure rather than invoking the bridge with zero arguments.
+    if (PyErr_Occurred() != nullptr) PyErr_Print();
+    else fprintf(stderr, "cxxnet capi: %s called with null args\n", fn);
+    return nullptr;
+  }
   PyObject* mod = Bridge();
   if (mod == nullptr) { Py_XDECREF(args); return nullptr; }
   PyObject* f = PyObject_GetAttrString(mod, fn);
@@ -102,10 +109,26 @@ const float* UnpackArray(PyObject* res, unsigned* oshape, int max_dim,
     if (out_dim != nullptr) *out_dim = 0;
     return nullptr;
   }
+  if (!PyTuple_Check(res) || PyTuple_Size(res) < 2) {
+    // an unexpected bridge return must not segfault the embedding host
+    fprintf(stderr, "cxxnet capi: bridge returned non-(bytes, shape) value\n");
+    Py_DECREF(res);
+    for (int i = 0; i < max_dim; ++i) oshape[i] = 0;
+    if (out_dim != nullptr) *out_dim = 0;
+    return nullptr;
+  }
   PyObject* bytes = PyTuple_GetItem(res, 0);   // borrowed
   PyObject* shape = PyTuple_GetItem(res, 1);
   char* data; Py_ssize_t len;
-  PyBytes_AsStringAndSize(bytes, &data, &len);
+  if (!PyTuple_Check(shape) ||
+      PyBytes_AsStringAndSize(bytes, &data, &len) != 0) {
+    if (PyErr_Occurred() != nullptr) PyErr_Print();
+    else fprintf(stderr, "cxxnet capi: bridge returned non-tuple shape\n");
+    Py_DECREF(res);
+    for (int i = 0; i < max_dim; ++i) oshape[i] = 0;
+    if (out_dim != nullptr) *out_dim = 0;
+    return nullptr;
+  }
   g_buf.assign(data, data + len);
   int nd = static_cast<int>(PyTuple_Size(shape));
   for (int i = 0; i < max_dim; ++i)

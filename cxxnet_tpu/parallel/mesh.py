@@ -32,8 +32,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 def parse_device_spec(spec: str) -> Optional[List[int]]:
     """Parse ``dev`` config value into a device-index list (None = all/default).
 
-    Mirrors nnet_impl-inl.hpp:38-67: 'gpu:0-3' is an inclusive-exclusive
-    range [0,3), 'gpu:0,2' an explicit list, bare 'gpu'/'cpu'/'tpu' = default.
+    Mirrors nnet_impl-inl.hpp:38-67: 'gpu:0-3' is an inclusive range
+    [0,3] (the reference loops ``for i=a; i<=b``), 'gpu:0,2' an explicit
+    list, bare 'gpu'/'cpu'/'tpu' = default (all devices).
     """
     spec = spec.strip()
     m = re.match(r"^[a-z]+$", spec)
@@ -41,7 +42,7 @@ def parse_device_spec(spec: str) -> Optional[List[int]]:
         return None
     m = re.match(r"^[a-z]+:(\d+)-(\d+)$", spec)
     if m:
-        return list(range(int(m.group(1)), int(m.group(2))))
+        return list(range(int(m.group(1)), int(m.group(2)) + 1))
     m = re.match(r"^[a-z]+:([\d,]+)$", spec)
     if m:
         return [int(x) for x in m.group(1).split(",")]
@@ -153,9 +154,13 @@ def maybe_distributed_init(cfg) -> bool:
     Process count/rank come from ``dist_num_proc``/``dist_rank`` or the
     standard cluster env detection. Returns True when initialization ran.
 
-    Config keys: dist_coordinator, dist_num_proc, dist_rank.
+    Config keys: dist_coordinator, dist_num_proc, dist_rank, dist_timeout
+    (seconds; bounds the coordinator handshake so a wrong address fails
+    with a diagnostic instead of hanging forever — the analog of the
+    reference tracker reporting bad ranks).
     """
     coord = num = rank = None
+    timeout = 300
     for k, v in cfg:
         if k == "dist_coordinator":
             coord = v
@@ -163,10 +168,19 @@ def maybe_distributed_init(cfg) -> bool:
             num = int(v)
         elif k == "dist_rank":
             rank = int(v)
+        elif k == "dist_timeout":
+            timeout = int(v)
     if not coord:
         return False
-    jax.distributed.initialize(coordinator_address=coord,
-                               num_processes=num, process_id=rank)
+    try:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=num, process_id=rank,
+                                   initialization_timeout=timeout)
+    except Exception as e:
+        raise RuntimeError(
+            f"distributed init failed (coordinator={coord!r}, rank={rank}, "
+            f"num_proc={num}, timeout={timeout}s): check dist_coordinator "
+            "is reachable from every rank and all ranks were launched") from e
     return True
 
 

@@ -109,6 +109,9 @@ class Trainer:
         self._train_step_fns: Dict[bool, Any] = {}
         self._eval_step_fn = None
         self._last_loss = None
+        self._sched_cache = None
+        self._mask_cache = None
+        self._rng_key = None
         # one-step deferred train-metric fetch: device->host reads of step
         # N's outputs happen after step N+1 is dispatched, so the transfer
         # overlaps compute instead of syncing every update (the reference
@@ -340,7 +343,9 @@ class Trainer:
             params, opt_state, accum = _apply_grads(
                 opt, period, do_update, params, opt_state, accum, grads,
                 sched)
-            return params, opt_state, new_state, accum, loss, top
+            # the rng key chains device-side (no per-step host upload)
+            return (params, opt_state, new_state, accum, loss, top,
+                    jax.random.fold_in(rng, 1))
 
         top_spec = P(data_axis, seq_axis, None, None)
         wrapped = jax.shard_map(
@@ -348,7 +353,7 @@ class Trainer:
             in_specs=(rep, rep, rep, rep,
                       P(data_axis, None, None, seq_axis),
                       P(data_axis, seq_axis), P(data_axis), rep, rep),
-            out_specs=(rep, rep, rep, rep, rep, top_spec))
+            out_specs=(rep, rep, rep, rep, rep, top_spec, rep))
         return jax.jit(wrapped, donate_argnums=(0, 1, 2, 3))
 
     def _make_train_step(self, do_update: bool):
@@ -371,14 +376,24 @@ class Trainer:
             params, opt_state, accum = _apply_grads(
                 opt, period, do_update, params, opt_state, accum, grads,
                 sched)
-            return params, opt_state, new_state, accum, loss, nodes
+            # the rng key chains device-side (no per-step host upload)
+            return (params, opt_state, new_state, accum, loss, nodes,
+                    jax.random.fold_in(rng, 1))
 
         return jax.jit(step, donate_argnums=(0, 1, 2, 3))
 
     def _sched_scalars(self):
+        """Schedule values as traced device scalars (no recompile when they
+        change). Cached by value: re-uploading identical scalars every step
+        costs a host->device transfer each (~ms over remote device links)."""
         sched = self.optimizer.schedules(self.epoch_counter)
-        return {tag: (jnp.float32(lr), jnp.float32(mom))
-                for tag, (lr, mom) in sched.items()}
+        key = tuple(sorted((tag, lr, mom)
+                           for tag, (lr, mom) in sched.items()))
+        if self._sched_cache is None or self._sched_cache[0] != key:
+            self._sched_cache = (key, {
+                tag: (jnp.float32(lr), jnp.float32(mom))
+                for tag, (lr, mom) in sched.items()})
+        return self._sched_cache[1]
 
     def update(self, batch: DataBatch) -> None:
         """One minibatch forward/backward(+update) — reference Update
@@ -393,22 +408,26 @@ class Trainer:
                 else self._make_train_step(do_update))
         step = self._train_step_fns[key]
         mask = self._mask(batch)
-        rng = jax.random.fold_in(self._base_key, self._step_count)
+        if self._rng_key is None:
+            self._rng_key = jax.random.fold_in(self._base_key,
+                                               self._step_count)
         accum_in = self.accum if self.update_period > 1 else {}
         if self._sp > 1:
             data, label = self._shard_seq_batch(batch.data, batch.label)
             (self.params, self.opt_state, self.net_state, accum, loss,
-             top) = step(self.params, self.opt_state, self.net_state,
-                         accum_in, data, label, mask, rng,
-                         self._sched_scalars())
+             top, self._rng_key) = step(
+                 self.params, self.opt_state, self.net_state,
+                 accum_in, data, label, mask, self._rng_key,
+                 self._sched_scalars())
             nodes = {_TOP: top}
         else:
             data, label = self.mesh.shard_batch(batch.data, batch.label)
             extra = tuple(self.mesh.shard_batch(e) for e in batch.extra_data)
             (self.params, self.opt_state, self.net_state, accum, loss,
-             nodes) = step(self.params, self.opt_state, self.net_state,
-                           accum_in, data, label, mask, extra, rng,
-                           self._sched_scalars())
+             nodes, self._rng_key) = step(
+                 self.params, self.opt_state, self.net_state,
+                 accum_in, data, label, mask, extra, self._rng_key,
+                 self._sched_scalars())
         if self.update_period > 1:
             self.accum = accum
         self._last_loss = loss
@@ -422,9 +441,17 @@ class Trainer:
             self._pending_metric = (nodes, batch)
 
     def _mask(self, batch: DataBatch):
+        # the all-ones mask (every batch except an epoch's padded tail) is
+        # cached device-side per batch size — no per-step H2D transfer
+        if not batch.num_batch_padd:
+            if self._mask_cache is None \
+                    or self._mask_cache[0] != batch.batch_size:
+                ones = np.ones((batch.batch_size,), np.float32)
+                self._mask_cache = (batch.batch_size,
+                                    self.mesh.shard_batch(ones))
+            return self._mask_cache[1]
         mask = np.ones((batch.batch_size,), np.float32)
-        if batch.num_batch_padd:
-            mask[batch.batch_size - batch.num_batch_padd:] = 0.0
+        mask[batch.batch_size - batch.num_batch_padd:] = 0.0
         return self.mesh.shard_batch(mask)
 
     def _local_rows(self, arr) -> Tuple[np.ndarray, np.ndarray]:
@@ -594,3 +621,36 @@ class Trainer:
     @property
     def last_loss(self) -> float:
         return float(self._last_loss) if self._last_loss is not None else float("nan")
+
+    # -- introspection -----------------------------------------------------
+    def step_cost_analysis(self, batch: DataBatch) -> Dict[str, float]:
+        """XLA cost analysis of the jitted train step: FLOPs and bytes
+        accessed per step, from the compiled executable. Grounds the bench's
+        MFU number the way the reference grounds health in GPU utilization
+        (reference doc/debug_perf.md:3-5 'normally above 95%')."""
+        assert self.params is not None, "call init_model() first"
+        key = (True, self._sp > 1)
+        if key not in self._train_step_fns:
+            self._train_step_fns[key] = (
+                self._make_sp_train_step(True) if self._sp > 1
+                else self._make_train_step(True))
+        step = self._train_step_fns[key]
+        mask = self._mask(batch)
+        rng = jax.random.fold_in(self._base_key, 0)
+        accum_in = self.accum if self.update_period > 1 else {}
+        if self._sp > 1:
+            data, label = self._shard_seq_batch(batch.data, batch.label)
+            lowered = step.lower(self.params, self.opt_state, self.net_state,
+                                 accum_in, data, label, mask, rng,
+                                 self._sched_scalars())
+        else:
+            data, label = self.mesh.shard_batch(batch.data, batch.label)
+            extra = tuple(self.mesh.shard_batch(e) for e in batch.extra_data)
+            lowered = step.lower(self.params, self.opt_state, self.net_state,
+                                 accum_in, data, label, mask, extra, rng,
+                                 self._sched_scalars())
+        cost = lowered.compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):      # older jax: one dict/device
+            cost = cost[0] if cost else {}
+        return {"flops": float(cost.get("flops", 0.0)),
+                "bytes_accessed": float(cost.get("bytes accessed", 0.0))}

@@ -24,6 +24,31 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import pytest
 
+# Build the native libraries from source when missing or stale — binaries
+# are not checked in (they are platform-specific and would silently go
+# stale when the .cc sources change).
+NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "cxxnet_tpu", "native")
+
+
+def build_native(lib_name: str, src_name: str):
+    """Run build.sh if ``lib_name`` is missing or older than ``src_name``.
+    Returns (lib_exists, build_stderr)."""
+    import subprocess
+    lib = os.path.join(NATIVE_DIR, lib_name)
+    src = os.path.join(NATIVE_DIR, src_name)
+    if os.path.exists(lib) and os.path.getmtime(lib) >= os.path.getmtime(src):
+        return True, ""
+    r = subprocess.run(["sh", os.path.join(NATIVE_DIR, "build.sh")],
+                       capture_output=True, text=True)
+    return os.path.exists(lib), r.stderr
+
+
+# Data-plane decoder: build failure is tolerable (io/native.py has cv2/PIL
+# fallbacks); test_capi.py does its own build-or-fail for the C ABI.
+build_native("libcxxnet_native.so", "decode.cc")
+
 
 @pytest.fixture(scope="session")
 def mesh8():

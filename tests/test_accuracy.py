@@ -1,0 +1,63 @@
+"""Real-data accuracy: train on the sklearn handwritten-digits export and
+assert convergence to the published-comparable error class (ACCURACY.md).
+
+This is the offline analog of the reference's MNIST convergence claim
+(~2% error in 15 rounds, /root/reference/example/MNIST/MNIST.conf:34-35):
+real images, real train/test split, the same `iter = mnist` idx path.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cxxnet_tpu.config import parse_config_file
+from cxxnet_tpu.io.data import create_iterator
+from cxxnet_tpu.main import split_sections
+from cxxnet_tpu.trainer import Trainer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def digits_data(tmp_path_factory):
+    from tools.make_digits import export
+    out = tmp_path_factory.mktemp("digits")
+    info = export(str(out))
+    assert info["n_train"] + info["n_test"] == 1797
+    return str(out)
+
+
+def _run_conf(rel, data_dir, mesh, rounds):
+    cfg = parse_config_file(os.path.join(REPO, "examples", "digits", rel))
+    cfg = [(k, v.replace("./examples/digits/data", data_dir)
+            if isinstance(v, str) else v) for k, v in cfg]
+    global_cfg, sections = split_sections(cfg)
+    tr = Trainer(global_cfg, mesh_ctx=mesh)
+    tr.init_model()
+    train_it = eval_it = None
+    for kind, name, pairs in sections:
+        if kind == "data":
+            train_it = create_iterator(pairs)
+        elif kind == "eval":
+            eval_it = create_iterator(pairs)
+    errs = []
+    for r in range(rounds):
+        tr.start_round(r)
+        for batch in train_it:
+            tr.update(batch)
+        errs.append(float(tr.evaluate(eval_it, "test").split(":")[-1]))
+    return errs
+
+
+def test_digits_mlp_accuracy(digits_data, mesh1):
+    errs = _run_conf("digits_mlp.conf", digits_data, mesh1, rounds=10)
+    assert min(errs) <= 0.06, f"digits MLP did not converge: {errs}"
+
+
+def test_digits_lenet_accuracy(digits_data, mesh1):
+    errs = _run_conf("digits_lenet.conf", digits_data, mesh1, rounds=10)
+    assert min(errs) <= 0.04, f"digits convnet did not converge: {errs}"

@@ -10,11 +10,24 @@ import os
 import numpy as np
 import pytest
 
-_LIB = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                    "cxxnet_tpu", "native", "libcxxnet_capi.so")
+from conftest import NATIVE_DIR, build_native
 
-pytestmark = pytest.mark.skipif(not os.path.exists(_LIB),
-                                reason="libcxxnet_capi.so not built")
+_LIB = os.path.join(NATIVE_DIR, "libcxxnet_capi.so")
+
+
+def _toolchain_available() -> bool:
+    import subprocess
+    return subprocess.run(["python3-config", "--embed", "--ldflags"],
+                          capture_output=True).returncode == 0
+
+
+if not _toolchain_available():
+    pytestmark = pytest.mark.skip(reason="no python3-config --embed")
+else:
+    # Build from source so the tests exercise the CURRENT capi.cc; with
+    # the toolchain present, a compile failure must FAIL, not skip.
+    ok, stderr = build_native("libcxxnet_capi.so", "capi.cc")
+    assert ok, f"capi.cc build failed:\n{stderr}"
 
 NET_CFG = b"""
 netconfig=start

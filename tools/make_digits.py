@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Export the scikit-learn handwritten-digits dataset to MNIST idx format.
+
+Real-data accuracy evidence for this framework (see ACCURACY.md): the
+environment has no network access, so the MNIST idx files themselves cannot
+be downloaded; sklearn's bundled `load_digits` (1797 real 8x8 handwritten
+digits from UCI Optical Recognition of Handwritten Digits) is the offline
+stand-in. The export writes standard idx-ubyte files (images magic 2051,
+labels magic 2049, gzip), so the unmodified `iter = mnist` path — the same
+iterator the reference drives with MNIST (iter_mnist-inl.hpp) — reads them.
+
+Usage:
+    python tools/make_digits.py <outdir> [--test-fraction 0.2] [--seed 0]
+
+Writes train-images-idx3-ubyte.gz / train-labels-idx1-ubyte.gz and the
+t10k-* pair, mirroring MNIST's file naming.
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import os
+import struct
+
+import numpy as np
+
+
+def write_idx(path: str, arr: np.ndarray) -> None:
+    """Write an idx-ubyte file (big-endian dims header, uint8 payload)."""
+    arr = np.ascontiguousarray(arr, dtype=np.uint8)
+    magic = 2048 + arr.ndim                       # 2051 images, 2049 labels
+    header = struct.pack(">i", magic) + b"".join(
+        struct.pack(">i", d) for d in arr.shape)
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "wb") as f:
+        f.write(header + arr.tobytes())
+
+
+def export(outdir: str, test_fraction: float = 0.2, seed: int = 0) -> dict:
+    from sklearn.datasets import load_digits
+
+    d = load_digits()
+    images = np.clip(d.images * 16.0, 0, 255).astype(np.uint8)  # 0..16 -> 0..255
+    labels = d.target.astype(np.uint8)
+    n = images.shape[0]
+    rng = np.random.RandomState(seed)
+    order = rng.permutation(n)
+    n_test = int(round(n * test_fraction))
+    test_idx, train_idx = order[:n_test], order[n_test:]
+
+    os.makedirs(outdir, exist_ok=True)
+    files = {
+        "train_img": os.path.join(outdir, "train-images-idx3-ubyte.gz"),
+        "train_lab": os.path.join(outdir, "train-labels-idx1-ubyte.gz"),
+        "test_img": os.path.join(outdir, "t10k-images-idx3-ubyte.gz"),
+        "test_lab": os.path.join(outdir, "t10k-labels-idx1-ubyte.gz"),
+    }
+    write_idx(files["train_img"], images[train_idx])
+    write_idx(files["train_lab"], labels[train_idx])
+    write_idx(files["test_img"], images[test_idx])
+    write_idx(files["test_lab"], labels[test_idx])
+    return {"n_train": len(train_idx), "n_test": len(test_idx), **files}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("outdir")
+    ap.add_argument("--test-fraction", type=float, default=0.2)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    info = export(args.outdir, args.test_fraction, args.seed)
+    print(f"wrote {info['n_train']} train / {info['n_test']} test digits "
+          f"to {args.outdir}")
+
+
+if __name__ == "__main__":
+    main()
