@@ -24,6 +24,8 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from .io import stream
+
 
 def _flatten(prefix: str, tree: Any, out: Dict[str, np.ndarray]) -> None:
     if isinstance(tree, dict):
@@ -63,14 +65,19 @@ def save_model(path: str, *, structure_sig: tuple, round_counter: int,
         json.dumps(meta).encode("utf-8"), dtype=np.uint8)
     buf = io.BytesIO()
     np.savez(buf, **arrays)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(buf.getvalue())
-    os.replace(tmp, path)
+    # local: tmp+rename; remote (gs://, s3://, ...): direct object PUT —
+    # the dmlc-Stream checkpoint parity (reference make/config.mk USE_HDFS)
+    stream.write_bytes_atomic(path, buf.getvalue())
 
 
 def load_model(path: str) -> Dict[str, Any]:
-    with np.load(path, allow_pickle=False) as z:
+    if stream.is_remote(path):
+        # remote: one ranged read into memory, then unpack
+        with stream.sopen(path, "rb") as f:
+            src = io.BytesIO(f.read())
+    else:
+        src = path                   # local: let np.load stream members
+    with np.load(src, allow_pickle=False) as z:
         arrays = {k: z[k] for k in z.files}
     meta = json.loads(bytes(arrays.pop("__meta__")).decode("utf-8"))
     groups: Dict[str, Dict[str, np.ndarray]] = {"params": {}, "state": {}, "opt": {}}
@@ -108,11 +115,12 @@ def model_path(model_dir: str, round_counter: int) -> str:
 
 
 def find_latest(model_dir: str) -> Optional[Tuple[int, str]]:
-    """Scan model_dir for the newest %04d.model (reference SyncLastestModel)."""
-    if not os.path.isdir(model_dir):
+    """Scan model_dir for the newest %04d.model (reference SyncLastestModel).
+    model_dir may be a remote URL (gs:// etc)."""
+    if not stream.isdir(model_dir):
         return None
     best = None
-    for fn in os.listdir(model_dir):
+    for fn in stream.listdir(model_dir):
         m = re.match(r"^(\d{4})\.model$", fn)
         if m:
             r = int(m.group(1))

@@ -109,8 +109,9 @@ def parse_config_string(text: str) -> ConfigPairs:
 
 
 def parse_config_file(path: str) -> ConfigPairs:
-    with open(path, "r") as f:
-        return parse_config_string(f.read())
+    from .io.stream import sopen
+    with sopen(path, "rb") as f:
+        return parse_config_string(f.read().decode("utf-8"))
 
 
 def parse_cli_overrides(argv: List[str]) -> ConfigPairs:

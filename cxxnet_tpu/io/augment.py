@@ -235,8 +235,10 @@ class MeanStore:
         self.path = path
         self.shape = shape_hwc
         self.mean: Optional[np.ndarray] = None
-        if path and os.path.exists(path):
-            self.mean = np.load(path)
+        from . import stream
+        if path and stream.exists(path):
+            with stream.sopen(path, "rb") as f:
+                self.mean = np.load(f)
 
     @property
     def ready(self) -> bool:
@@ -251,7 +253,9 @@ class MeanStore:
             n += 1
         self.mean = (acc / max(n, 1)).astype(np.float32)
         if self.path:
-            np.save(self.path, self.mean)
+            from . import stream
+            with stream.sopen(self.path, "wb") as f:
+                np.save(f, self.mean)
 
     def apply(self, img: np.ndarray, p: AugmentParams) -> np.ndarray:
         if p.mean_value is not None:

@@ -14,11 +14,12 @@ iter_thread_imbin-inl.hpp:17-284).
 
 from __future__ import annotations
 
-import os
 import struct
 from typing import Iterator, List, Tuple
 
 import numpy as np
+
+from .stream import getsize, sopen
 
 PAGE_INTS = 64 << 18
 PAGE_BYTES = PAGE_INTS * 4
@@ -29,7 +30,7 @@ class BinaryPageWriter:
     BinaryPage::Push + tools/im2bin.cpp main loop)."""
 
     def __init__(self, path: str):
-        self._f = open(path, "wb")
+        self._f = sopen(path, "wb")
         self._clear()
 
     def _clear(self) -> None:
@@ -79,13 +80,13 @@ class BinaryPageWriter:
 
 def page_object_count(path: str, page_idx: int) -> int:
     """Object count of one page without reading the full 64 MiB."""
-    with open(path, "rb") as f:
+    with sopen(path, "rb") as f:
         f.seek(page_idx * PAGE_BYTES)
         return struct.unpack("<i", f.read(4))[0]
 
 
 def num_pages(path: str) -> int:
-    size = os.path.getsize(path)
+    size = getsize(path)
     if size % PAGE_BYTES:
         raise ValueError(f"{path}: size {size} is not a whole number of "
                          f"64MiB BinaryPages")
@@ -101,7 +102,7 @@ def iter_binpage(path: str, part: int = 0, nsplit: int = 1) \
     # global start index of each page (cheap header reads)
     counts = [page_object_count(path, p) for p in range(n_pages)]
     starts = np.concatenate([[0], np.cumsum(counts)[:-1]]).astype(np.int64)
-    with open(path, "rb") as f:
+    with sopen(path, "rb") as f:
         for p in range(part, n_pages, nsplit):
             f.seek(p * PAGE_BYTES)
             page = f.read(PAGE_BYTES)

@@ -51,6 +51,33 @@ def decode_image(data: bytes, want_channels: int = 3) -> np.ndarray:
         return a[:, :, None] if gray else a
 
 
+def expand_conf_files(prefix: str, ids: str, rank: int, nworker: int):
+    """Expand ``image_conf_prefix``/``image_conf_ids`` into this worker's
+    (bin, lst) file pairs (reference iter_thread_imbin_x-inl.hpp:113-150):
+    ids is an inclusive range 'lb-ub', each id formats the printf-style
+    prefix, and workers take contiguous chunks of ceil(n/nworker) files."""
+    import re
+    m = re.match(r"^(-?\d+)-(-?\d+)$", ids.strip())
+    if not m:
+        raise ValueError(
+            f"image_conf_ids only supports a range like 1-100, got {ids!r}")
+    lb, ub = int(m.group(1)), int(m.group(2))
+    n = ub + 1 - lb
+    if n <= 0:
+        raise ValueError(f"image_conf_ids: empty range {ids!r}")
+    if nworker > 1:
+        step = (n + nworker - 1) // nworker
+        begin = min(rank * step, n) + lb
+        end = min((rank + 1) * step, n) + lb
+        if begin >= end:
+            raise ValueError(
+                "image_conf: too many workers — the id list cannot be "
+                "divided between them")
+        lb, ub = begin, end - 1
+    return [((prefix % i) + ".bin", (prefix % i) + ".lst")
+            for i in range(lb, ub + 1)]
+
+
 @register_iter("imgrec", "imgbin", "imgbinx", "imginst", "imgbinold")
 class ImageRecordIterator(DataIter):
     """Batched, augmented, sharded image-record reader."""
@@ -64,6 +91,13 @@ class ImageRecordIterator(DataIter):
             self.bin_path = val
         elif name in ("image_list", "path_imglist"):
             self.list_path = val
+        elif name == "image_conf_prefix":
+            # printf-style template for multi-file BinaryPage packs
+            # (reference iter_thread_imbin_x-inl.hpp:113-150): each id in
+            # image_conf_ids expands to <prefix%id>.bin/.lst
+            self.conf_prefix = val
+        elif name == "image_conf_ids":
+            self.conf_ids = val
         elif name == "batch_size":
             self.batch_size = int(val)
         elif name == "input_shape":
@@ -91,6 +125,8 @@ class ImageRecordIterator(DataIter):
         self.rec_path = ""
         self.bin_path = ""
         self.list_path = ""
+        self.conf_prefix = ""
+        self.conf_ids = ""
         self.batch_size = 128
         self.input_shape = None
         self.shuffle = 0
@@ -107,7 +143,16 @@ class ImageRecordIterator(DataIter):
 
     # -- setup -------------------------------------------------------------
     def init(self):
-        if not self.rec_path and not self.bin_path:
+        if self.conf_prefix:
+            if self.rec_path or self.bin_path or self.list_path:
+                raise ValueError(
+                    "set either image_conf_prefix or image_bin/image_list, "
+                    "not both (reference iter_thread_imbin_x-inl.hpp:124)")
+            self._conf_pairs = expand_conf_files(
+                self.conf_prefix, self.conf_ids, self.rank, self.nworker)
+            if self.round_batch and self.nworker > 1:
+                self._check_conf_batch_counts()
+        elif not self.rec_path and not self.bin_path:
             raise ValueError("imgrec: image_rec (or image_bin) must be set")
         if self.bin_path and not self.list_path:
             raise ValueError("imgbin: image_list must accompany image_bin "
@@ -134,10 +179,44 @@ class ImageRecordIterator(DataIter):
         self.before_first()
 
 
+    def _check_conf_batch_counts(self) -> None:
+        """Whole-file conf-prefix sharding gives each rank ceil(shard/batch)
+        batches; when shards are uneven enough that those counts differ,
+        round_batch CANNOT equalize epochs and every jitted update would
+        deadlock on a missing rank. Fail fast at init (counting .lst lines
+        is cheap and the lists are on the shared filesystem)."""
+        counts = []
+        for rank in range(self.nworker):
+            pairs = expand_conf_files(self.conf_prefix, self.conf_ids,
+                                      rank, self.nworker)
+            n = sum(len(read_image_list(lst)) for _, lst in pairs)
+            counts.append(-(-n // self.batch_size))      # ceil
+        if len(set(counts)) != 1:
+            raise ValueError(
+                "image_conf_prefix + round_batch: per-rank batch counts "
+                f"{counts} are unequal — whole-file sharding cannot give "
+                "every worker the same epoch length with these pack sizes; "
+                "re-pack into equal-size parts (tools/im2bin.py) or use a "
+                "single recordio file (byte-range sharded)")
+
     def _reader(self):
-        """Iterable of packed ImageRecord payloads: recordio, or a legacy
+        """Iterable of packed ImageRecord payloads: recordio, a legacy
         BinaryPage pack re-wrapped on the fly (k-th object pairs with the
-        k-th image_list line for inst_id/label)."""
+        k-th image_list line for inst_id/label), or this worker's slice of
+        a multi-file conf-prefix pack set."""
+        if self.conf_prefix:
+            from .binpage import iter_binpage
+
+            def gen_multi():
+                for bin_path, lst_path in self._conf_pairs:
+                    entries = read_image_list(lst_path)
+                    # file-level partitioning only: each worker owns whole
+                    # files, so no intra-file (rank, nworker) split here
+                    for obj_idx, data in iter_binpage(bin_path, 0, 1):
+                        inst_id, labels, _ = entries[obj_idx]
+                        yield ImageRecord(inst_id=inst_id, labels=labels,
+                                          data=data).pack()
+            return gen_multi()
         if not self.bin_path:
             return RecordReader(self.rec_path, self.rank, self.nworker)
         from .binpage import iter_binpage
@@ -194,6 +273,13 @@ class ImageRecordIterator(DataIter):
             lab = rec.labels
         return img, pack_label(lab, self.label_width), rec.inst_id
 
+    def _decode_raw(self, raw):
+        """Decode a list of packed payloads on the pool with fresh
+        deterministic per-item seeds."""
+        seeds = range(self._item_counter, self._item_counter + len(raw))
+        self._item_counter += len(raw)
+        return list(self._pool.map(self._process_one, raw, seeds))
+
     def _fill(self, n: int) -> None:
         """Read up to n raw records, decode them on the pool."""
         raw = []
@@ -205,10 +291,25 @@ class ImageRecordIterator(DataIter):
             self._done = True
         if self.shuffle:
             self._rng.shuffle(raw)
-        seeds = range(self._item_counter, self._item_counter + len(raw))
-        self._item_counter += len(raw)
-        out = list(self._pool.map(self._process_one, raw, seeds))
-        self._buf.extend(out)
+        self._buf.extend(self._decode_raw(raw))
+
+    def _wrap_fill(self, n: int):
+        """Decode the first ``n`` records of this worker's shard again —
+        round_batch wraparound (reference iter_batch_proc-inl.hpp:85-99):
+        every rank emits ceil(shard/batch) full batches per epoch, with the
+        wrapped duplicates counted as padding so loss/metrics exclude them."""
+        reader = self._reader()
+        raw = []
+        try:
+            for payload in reader:
+                raw.append(payload)
+                if len(raw) >= n:
+                    break
+        finally:
+            close = getattr(reader, "close", None)
+            if close is not None:
+                close()
+        return self._decode_raw(raw)
 
     def next(self) -> Optional[DataBatch]:
         bs = self.batch_size
@@ -222,7 +323,10 @@ class ImageRecordIterator(DataIter):
         padd = 0
         if len(take) < bs:
             padd = bs - len(take)
-            take = take + [take[-1]] * padd
+            if self.round_batch:
+                take = take + self._wrap_fill(padd)
+            if len(take) < bs:          # shard smaller than the shortfall
+                take = take + [take[-1]] * (bs - len(take))
         data = np.stack([t[0] for t in take])
         label = np.stack([t[1] for t in take])
         index = np.asarray([t[2] for t in take], np.int64)

@@ -9,19 +9,18 @@ when round_batch is on — supported here too).
 
 from __future__ import annotations
 
-import gzip
 import struct
 from typing import Optional
 
 import numpy as np
 
 from .data import DataBatch, DataIter, register_iter
+from .stream import open_maybe_gz as _open_maybe_gz_stream
 
 
 def _open_maybe_gz(path: str):
-    if path.endswith(".gz"):
-        return gzip.open(path, "rb")
-    return open(path, "rb")
+    # local or remote (gs:// etc), transparently gunzipped
+    return _open_maybe_gz_stream(path)
 
 
 def read_idx(path: str) -> np.ndarray:

@@ -19,11 +19,12 @@ Image payload:
 from __future__ import annotations
 
 import dataclasses
-import os
 import struct
 from typing import BinaryIO, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from .stream import getsize, sopen
 
 MAGIC = 0xCED7ABEF
 _HDR = struct.Struct("<II")
@@ -36,7 +37,7 @@ def _pad8(n: int) -> int:
 
 class RecordWriter:
     def __init__(self, path: str):
-        self._f: BinaryIO = open(path, "wb")
+        self._f: BinaryIO = sopen(path, "wb")
 
     def write(self, payload: bytes) -> None:
         self._f.write(_HDR.pack(MAGIC, len(payload)))
@@ -65,8 +66,8 @@ class RecordReader:
 
     def __init__(self, path: str, part: int = 0, nsplit: int = 1):
         self.path = path
-        size = os.path.getsize(path)
-        self._f = open(path, "rb")
+        size = getsize(path)
+        self._f = sopen(path, "rb")
         self.begin = size * part // nsplit
         self.end = size * (part + 1) // nsplit
         self._resync(self.begin)
@@ -150,7 +151,8 @@ def read_image_list(path: str) -> List[Tuple[int, np.ndarray, str]]:
     ``index  label[ label2 ...]  relative_path`` (reference ImageLabelMap,
     iter_image_recordio-inl.hpp:28-90 and tools/im2rec.cc)."""
     out = []
-    with open(path) as f:
+    import io as _io
+    with _io.TextIOWrapper(sopen(path, "rb")) as f:
         for line in f:
             parts = line.strip().split()
             if len(parts) < 3:

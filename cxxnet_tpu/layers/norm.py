@@ -8,10 +8,19 @@ Reference: BatchNormLayer<xpu, moving_avg>
     scoping follows those tags;
   * ``batch_norm`` keeps running stats with ``bn_momentum`` (train-time EMA,
     used at eval); ``batch_norm_no_ma`` recomputes batch stats at eval;
-  * running stats initialize to zero (:48-52) — reference parity;
-  * stats are computed on the *local* (per-device) batch slice, matching the
-    reference's per-GPU BN (no cross-replica sync; see SURVEY §7 risks). A
-    cross-replica psum variant can be layered on for TPU when wanted.
+  * running stats initialize to zero (:48-52) — reference parity.
+
+Deliberate deviation — sync-BN: under the GSPMD train step the batch axis
+is sharded over the 'data' mesh axis, so ``jnp.mean`` over axis 0 reduces
+across ALL replicas (XLA inserts the cross-replica collective). The
+reference computes per-GPU stats only because each GPU ran an independent
+Backprop (batch_norm_layer-inl.hpp per-device stats, SURVEY §7 risks);
+that was a hardware artifact, not a modeling choice, and global-batch
+stats strictly dominate (per-GPU BN is the limit sync-BN approaches as
+device count -> 1). Pinned by tests/test_layers.py::test_batch_norm_sync
+on the 8-device mesh. No per-replica mode is offered: in a single GSPMD
+program, shard-local statistics would require an extra shard_map seam for
+a semantics nobody wants on TPU.
 """
 
 from __future__ import annotations

@@ -177,7 +177,8 @@ class LearnTask:
         if itr_train is None:
             raise ValueError("no training data section (data = ...) in config")
         evals = self.eval_iters()
-        os.makedirs(self.model_dir, exist_ok=True)
+        from .io import stream
+        stream.makedirs(self.model_dir)
         if self.profile_dir:
             import jax
             jax.profiler.start_trace(self.profile_dir)
@@ -192,8 +193,9 @@ class LearnTask:
                 if not self.silent:
                     print(f"profiler trace written to {self.profile_dir}")
         if self.save_model and not self.test_io:
+            from .io import stream
             final = ckpt.model_path(self.model_dir, self.num_round - 1)
-            if not os.path.exists(final):
+            if not stream.exists(final):
                 tr.save_model(final)
         tr.wait_saves()       # drain async checkpoint writes before exit
 

@@ -298,6 +298,28 @@ class Trainer:
         """Top-level layer names present in the param tree."""
         return list(self.params.keys())
 
+    def get_state(self, layer_name: str, tag: str) -> np.ndarray:
+        """Read a layer-state entry (e.g. batch_norm running stats)."""
+        return np.asarray(self._walk(self.net_state, layer_name, tag))
+
+    def set_states(self, updates) -> None:
+        """Bulk layer-state assignment (``updates``: {(layer, dotted_tag):
+        array}) — the state analog of set_weights, used by weight importers
+        to land e.g. Caffe BatchNorm running stats."""
+        st = ckpt.jax_to_numpy(self.net_state)
+        for (layer, tag), v in updates.items():
+            parts = tag.split(".")
+            node = st[layer]
+            for part in parts[:-1]:
+                node = node[part]
+            cur = node[parts[-1]]
+            if tuple(np.shape(v)) != tuple(np.shape(cur)):
+                raise ValueError(
+                    f"set_state {layer}.{tag}: shape {np.shape(v)} != "
+                    f"{tuple(np.shape(cur))}")
+            node[parts[-1]] = np.asarray(v, dtype=np.asarray(cur).dtype)
+        self.net_state = self.mesh.replicate(st)
+
     # -- train step --------------------------------------------------------
     def _needed_nodes(self) -> List[str]:
         return sorted({n for n in self._metric_nodes if n is not None})

@@ -109,6 +109,72 @@ divideby = 255
         np.concatenate([b.label for b in batches2]))
 
 
+def test_imgbinx_conf_prefix_multifile(tmp_path):
+    """imgbinx multi-file packs: image_conf_prefix/image_conf_ids expand to
+    per-id .bin/.lst pairs, and distributed workers take contiguous chunks
+    of whole files (reference iter_thread_imbin_x-inl.hpp:113-150)."""
+    from PIL import Image
+    import im2bin
+    # 3 packs x 4 images, global labels 0..11 so provenance is checkable
+    for part in range(3):
+        root = tmp_path / f"imgs{part}"
+        root.mkdir()
+        rng = np.random.RandomState(part)
+        lines = []
+        for i in range(4):
+            gid = part * 4 + i
+            arr = rng.randint(0, 255, (8, 8, 3), np.uint8)
+            Image.fromarray(arr).save(root / f"im{i}.jpg", quality=95)
+            lines.append(f"{gid}\t{gid}\tim{i}.jpg")
+        lst = tmp_path / ("part-%03d.lst" % part)
+        lst.write_text("\n".join(lines) + "\n")
+        sys.argv = ["im2bin", str(lst), str(root) + os.sep,
+                    str(tmp_path / ("part-%03d.bin" % part))]
+        assert im2bin.main() == 0
+
+    def labels_for(rank, nworker):
+        cfg = f"""
+iter = imgbinx
+image_conf_prefix = {tmp_path}/part-%03d
+image_conf_ids = 0-2
+batch_size = 4
+input_shape = 3,8,8
+dist_num_worker = {nworker}
+dist_worker_rank = {rank}
+"""
+        it = create_iterator(parse_config_string(cfg))
+        out = []
+        for b in it:
+            n_real = b.batch_size - b.num_batch_padd
+            out.extend(b.label[:n_real, 0].astype(int).tolist())
+        return out
+
+    # single worker sees all 3 files in id order
+    assert labels_for(0, 1) == list(range(12))
+    # two workers: ceil(3/2)=2 files for rank 0, 1 file for rank 1
+    assert labels_for(0, 2) == list(range(8))
+    assert labels_for(1, 2) == list(range(8, 12))
+    # too many workers for the id list fails fast
+    from cxxnet_tpu.io.iter_imgrec import expand_conf_files
+    with pytest.raises(ValueError):
+        expand_conf_files(str(tmp_path / "part-%03d"), "0-2", 3, 4)
+    # round_batch cannot equalize uneven whole-file shards (2 files vs 1
+    # -> 2 batches vs 1): init must fail fast instead of deadlocking the
+    # distributed epoch later
+    with pytest.raises(ValueError, match="batch counts"):
+        create_iterator([
+            ("iter", "imgbinx"),
+            ("image_conf_prefix", f"{tmp_path}/part-%03d"),
+            ("image_conf_ids", "0-2"),
+            ("batch_size", "4"),
+            ("input_shape", "3,8,8"),
+            ("round_batch", "1"),
+            ("dist_num_worker", "2"),
+            ("dist_worker_rank", "0"),
+            ("iter", "end"),
+        ])
+
+
 def test_imgbin_requires_list(tmp_path):
     with pytest.raises(ValueError):
         create_iterator(parse_config_string(f"""

@@ -101,6 +101,50 @@ def test_imgrec_iterator(rec_file, tmp_path):
     assert len(list(it)) == 3
 
 
+def test_imgrec_round_batch(rec_file):
+    """round_batch on the imgrec path: every worker emits the same number
+    of full batches per epoch, tail shortfalls wrap to the shard's start,
+    and wrapped duplicates are counted as padding (reference
+    iter_batch_proc-inl.hpp:85-99 distributed-epoch semantic)."""
+    def batches_for(rank, nworker, round_batch):
+        cfg = [
+            ("iter", "imgrec"),
+            ("image_rec", rec_file),
+            ("input_shape", "3,32,32"),
+            ("batch_size", "8"),
+            ("round_batch", str(round_batch)),
+            ("dist_num_worker", str(nworker)),
+            ("dist_worker_rank", str(rank)),
+            ("iter", "end"),
+        ]
+        return list(create_iterator(cfg))
+
+    per_rank = [batches_for(r, 2, 1) for r in range(2)]
+    # equal batch counts across ranks (the collective-safety property)
+    assert len(per_rank[0]) == len(per_rank[1])
+    for rank_batches in per_rank:
+        shard_ids = set()
+        for b in rank_batches[:-1]:
+            assert b.num_batch_padd == 0
+            shard_ids.update(b.inst_index.tolist())
+        tail = rank_batches[-1]
+        assert tail.num_batch_padd > 0
+        n_real = tail.batch_size - tail.num_batch_padd
+        shard_ids.update(tail.inst_index[:n_real].tolist())
+        # wrapped rows are REAL records from this shard's start, not
+        # repeats of the final row
+        wrapped = tail.inst_index[n_real:].tolist()
+        assert all(w in shard_ids for w in wrapped)
+        assert len(set(wrapped)) == len(wrapped)
+    # both shards together cover the full file exactly once (real rows)
+    all_real = []
+    for rank_batches in per_rank:
+        for b in rank_batches:
+            n_real = b.batch_size - b.num_batch_padd
+            all_real.extend(b.inst_index[:n_real].tolist())
+    assert sorted(all_real) == list(range(20))
+
+
 def test_imgrec_mean_and_labels(rec_file, tmp_path):
     mean_path = str(tmp_path / "mean.bin")
     cfg = [

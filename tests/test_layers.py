@@ -154,6 +154,38 @@ def test_batch_norm_train_stats():
                                0.1 * x.mean(axis=(0, 1, 2)), rtol=1e-3)
 
 
+def test_batch_norm_sync(mesh8):
+    """Pins the documented sync-BN semantics (layers/norm.py): with the
+    batch sharded over 8 devices, training stats reduce over the GLOBAL
+    batch, not each device's local slice — running_exp after one step must
+    match the full-batch mean, which differs per-shard by construction."""
+    from cxxnet_tpu.trainer import Trainer
+    from cxxnet_tpu.io.data import DataBatch
+    cfg = parse_config_string("""
+netconfig=start
+layer[+1:b1] = batch_norm:bn
+layer[+1:o] = fullc:fc
+  nhidden = 2
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,4
+batch_size = 64
+eta = 0.0
+metric = error
+eval_train = 0
+""")
+    tr = Trainer(cfg, mesh_ctx=mesh8)
+    tr.init_model()
+    # row i has value i in every feature: each device shard of 8 rows has a
+    # different local mean (3.5, 11.5, ...), global mean = 31.5
+    x = np.tile(np.arange(64, dtype=np.float32)[:, None, None, None],
+                (1, 1, 1, 4))
+    b = DataBatch(data=x, label=np.zeros((64, 1), np.float32))
+    tr.update(b)
+    running = np.asarray(tr.net_state["bn"]["running_exp"])
+    np.testing.assert_allclose(running, 0.1 * 31.5 * np.ones(4), rtol=1e-4)
+
+
 def test_batch_norm_no_ma_eval_uses_batch_stats():
     net = make_net("layer[0->1] = batch_norm_no_ma", input_shape="4,6,6")
     x = (np.random.RandomState(7).randn(8, 6, 6, 4) * 3 + 2).astype(np.float32)

@@ -95,6 +95,141 @@ def test_import_rename_map(conf_path, tmp_path):
     assert n == 1
 
 
+# ---- caffe importer --------------------------------------------------------
+
+def _vint(n):
+    out = b""
+    while True:
+        b7 = n & 0x7F
+        n >>= 7
+        if n:
+            out += bytes([b7 | 0x80])
+        else:
+            return out + bytes([b7])
+
+
+def _ld(field, payload):
+    return _vint((field << 3) | 2) + _vint(len(payload)) + payload
+
+
+def _varint_field(field, val):
+    return _vint(field << 3) + _vint(val)
+
+
+def _blob(arr, legacy=False):
+    arr = np.asarray(arr, np.float32)
+    msg = b""
+    if legacy:
+        dims = list(arr.shape) + [1] * (4 - arr.ndim)
+        for i, d in enumerate(dims):
+            msg += _varint_field(i + 1, d)
+    else:
+        shape_msg = _ld(1, b"".join(_vint(d) for d in arr.shape))
+        msg += _ld(7, shape_msg)
+    msg += _ld(5, arr.tobytes())                 # packed float data
+    return msg
+
+
+def _caffe_layer_new(name, ltype, blobs):
+    msg = _ld(1, name.encode()) + _ld(2, ltype.encode())
+    for b in blobs:
+        msg += _ld(7, _blob(b))
+    return _ld(100, msg)
+
+
+def _caffe_layer_v1(name, tcode, blobs):
+    msg = _ld(4, name.encode()) + _varint_field(5, tcode)
+    for b in blobs:
+        msg += _ld(6, _blob(b, legacy=True))
+    return _ld(2, msg)
+
+
+CONV_CONF = """
+netconfig=start
+layer[0->1] = conv:cv1
+  kernel_size = 3
+  nchannel = 4
+  pad = 1
+layer[+1:b] = batch_norm:bn1
+layer[+1] = relu
+layer[+1] = flatten:fl
+layer[+1] = fullc:ip1
+  nhidden = 3
+layer[+0] = softmax
+netconfig=end
+input_shape = 3,6,6
+batch_size = 8
+eta = 0.1
+"""
+
+
+def test_import_caffemodel(tmp_path):
+    """Synthetic .caffemodel (hand-encoded NetParameter wire format) lands
+    in same-named layers: conv OIHW->HWIO with first-conv BGR->RGB flip,
+    InnerProduct transposed, BatchNorm stats into layer state, Scale
+    mapped onto the batch_norm params via --map. Mirrors reference
+    tools/caffe_converter/convert.cpp:30-187 without needing Caffe."""
+    rng = np.random.RandomState(0)
+    wc = rng.randn(4, 3, 3, 3).astype(np.float32)        # OIHW
+    bc = rng.randn(4).astype(np.float32)
+    wip = rng.randn(3, 144).astype(np.float32)           # (out, in)
+    bip = rng.randn(3).astype(np.float32)
+    mean, var = rng.randn(4).astype(np.float32), rng.rand(4).astype(np.float32)
+    gamma, beta = rng.randn(4).astype(np.float32), rng.randn(4).astype(np.float32)
+    blob = (_caffe_layer_new("cv1", "Convolution", [wc, bc])
+            + _caffe_layer_new("bn1", "BatchNorm",
+                               [mean * 2.0, var * 2.0, np.asarray([2.0])])
+            + _caffe_layer_new("scale1", "Scale", [gamma, beta])
+            + _caffe_layer_new("ip1", "InnerProduct", [wip, bip]))
+    src = tmp_path / "m.caffemodel"
+    src.write_bytes(blob)
+    conf = tmp_path / "net.conf"
+    conf.write_text(CONV_CONF)
+    out = tmp_path / "out.model"
+
+    from import_weights import import_weights
+    n = import_weights(str(conf), str(src), str(out), fmt="caffe",
+                       rename={"scale1": "bn1"}, verbose=False)
+    assert n == 8
+
+    from cxxnet_tpu.config import parse_config_string
+    from cxxnet_tpu.trainer import Trainer
+    tr = Trainer(parse_config_string(CONV_CONF + "dev = cpu\n"))
+    tr.init_model()
+    tr.load_model(str(out))
+    # conv: BGR->RGB flip on input channels then OIHW -> HWIO
+    np.testing.assert_allclose(tr.get_weight("cv1", "wmat"),
+                               wc[:, ::-1].transpose(2, 3, 1, 0))
+    np.testing.assert_allclose(tr.get_weight("cv1", "bias"), bc)
+    # fullc transposed to (in, out)
+    np.testing.assert_allclose(tr.get_weight("ip1", "wmat"), wip.T)
+    # BN stats divided by the scale factor, landed in state
+    np.testing.assert_allclose(tr.get_state("bn1", "running_exp"), mean,
+                               rtol=1e-6)
+    np.testing.assert_allclose(tr.get_state("bn1", "running_var"), var,
+                               rtol=1e-6)
+    # Scale layer mapped onto batch_norm gamma/beta
+    np.testing.assert_allclose(tr.get_weight("bn1", "wmat"), gamma)
+    np.testing.assert_allclose(tr.get_weight("bn1", "bias"), beta)
+
+
+def test_import_caffemodel_v1_format(tmp_path):
+    """Legacy V1LayerParameter (field 2, enum types, legacy NCHW blob
+    dims) parses too — pretrained-era models use this encoding."""
+    rng = np.random.RandomState(1)
+    wc = rng.randn(2, 3, 3, 3).astype(np.float32)
+    bc = rng.randn(2).astype(np.float32)
+    blob = _caffe_layer_v1("cv1", 4, [wc, bc])          # 4 = CONVOLUTION
+    src = tmp_path / "v1.caffemodel"
+    src.write_bytes(blob)
+    from import_caffe import caffe_to_keys, parse_caffemodel
+    layers = parse_caffemodel(str(src))
+    assert [(l["name"], l["type"]) for l in layers] == [("cv1", "Convolution")]
+    keys = caffe_to_keys(layers, rgb_flip=False)
+    np.testing.assert_allclose(keys["cv1.wmat"], wc.transpose(2, 3, 1, 0))
+    np.testing.assert_allclose(keys["cv1.bias"], bc)
+
+
 def test_import_nested_dotted_keys(tmp_path):
     """npz keys addressing nested mha params ('attn.q.wmat') resolve by
     longest-prefix layer matching."""

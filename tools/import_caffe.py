@@ -1,0 +1,216 @@
+#!/usr/bin/env python
+"""Import a Caffe ``.caffemodel`` into a cxxnet_tpu model checkpoint.
+
+The reference converter (tools/caffe_converter/convert.cpp:30-187) links
+against a full Caffe build and copies InnerProduct/Convolution blobs into
+same-named cxxnet layers (with a BGR->RGB flip on the first conv). Here
+the ``.caffemodel`` (a serialized ``caffe.NetParameter`` protobuf) is
+parsed directly at the wire-format level — no Caffe, no compiled protos —
+and the blobs land through the same name-matched, shape-checked path as
+tools/import_weights.py.
+
+Layer mapping:
+  * Convolution  blob0 (cout,cin,kh,kw) -> wmat HWIO; blob1 -> bias.
+    The FIRST conv's input channels are reversed (BGR->RGB) when they
+    number 3, matching the reference converter (convert.cpp:118-121);
+    disable with --no-rgb-flip.
+  * InnerProduct blob0 (out,in) -> wmat (in,out); blob1 -> bias.
+  * BatchNorm    blobs (mean, var, scale_factor) -> running_exp/
+    running_var = mean/sf, var/sf (state, not params).
+  * Scale        blobs (gamma, beta) -> wmat/bias of the same-named layer
+    (use --map scale_x=bn_x to land them on the batch_norm layer).
+
+Usage:
+  python tools/import_caffe.py <net.conf> <model.caffemodel> <out.model>
+      [--map src=dst ...] [--strict] [--no-rgb-flip]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import struct
+import sys
+from typing import Dict, Iterator, List, Tuple
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# ---- minimal protobuf wire-format reader ----------------------------------
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    out = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+
+
+def iter_fields(buf: bytes) -> Iterator[Tuple[int, int, object]]:
+    """Yield (field_number, wire_type, value) for one message's bytes.
+    Length-delimited values come back as bytes; varints as int; fixed32/64
+    as raw 4/8-byte chunks."""
+    pos = 0
+    while pos < len(buf):
+        key, pos = _read_varint(buf, pos)
+        field, wt = key >> 3, key & 7
+        if wt == 0:
+            val, pos = _read_varint(buf, pos)
+        elif wt == 1:
+            val, pos = buf[pos:pos + 8], pos + 8
+        elif wt == 2:
+            ln, pos = _read_varint(buf, pos)
+            val, pos = buf[pos:pos + ln], pos + ln
+        elif wt == 5:
+            val, pos = buf[pos:pos + 4], pos + 4
+        else:
+            raise ValueError(f"unsupported protobuf wire type {wt}")
+        yield field, wt, val
+
+
+def _floats(entries: List[Tuple[int, object]]) -> np.ndarray:
+    """Repeated float field: packed (wt=2 bytes) and/or unpacked (wt=5)."""
+    chunks = []
+    for wt, v in entries:
+        if wt == 2:
+            chunks.append(np.frombuffer(v, "<f4"))
+        else:
+            chunks.append(np.frombuffer(v, "<f4", 1))
+    return np.concatenate(chunks) if chunks else np.zeros((0,), np.float32)
+
+
+def parse_blob(buf: bytes) -> np.ndarray:
+    """BlobProto -> shaped float32 array (new BlobShape or legacy NCHW)."""
+    data: List[Tuple[int, object]] = []
+    legacy = {1: 0, 2: 0, 3: 0, 4: 0}
+    shape: List[int] = []
+    for field, wt, val in iter_fields(buf):
+        if field == 5:
+            data.append((wt, val))
+        elif field == 7:                       # BlobShape{ repeated dim=1 }
+            for f2, wt2, v2 in iter_fields(val):
+                if f2 == 1:
+                    if wt2 == 2:               # packed varints
+                        p = 0
+                        while p < len(v2):
+                            d, p = _read_varint(v2, p)
+                            shape.append(d)
+                    else:
+                        shape.append(v2)
+        elif field in legacy and wt == 0:
+            legacy[field] = val
+    arr = _floats(data)
+    if not shape:
+        shape = [d for d in (legacy[1], legacy[2], legacy[3], legacy[4]) if d]
+    if shape and int(np.prod(shape)) == arr.size:
+        arr = arr.reshape(shape)
+    return arr
+
+
+# V1LayerParameter.LayerType enum values used by the reference converter
+_V1_TYPES = {4: "Convolution", 14: "InnerProduct"}
+
+
+def parse_caffemodel(path: str) -> List[Dict]:
+    """NetParameter -> [{'name', 'type', 'blobs': [arrays]}] for layers
+    that carry blobs. Handles both the new `layer = 100` (string types)
+    and legacy `layers = 2` (V1 enum types) fields."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    out = []
+    for field, wt, val in iter_fields(buf):
+        if field == 100:                       # LayerParameter
+            name = ltype = ""
+            blobs = []
+            for f2, wt2, v2 in iter_fields(val):
+                if f2 == 1:
+                    name = v2.decode("utf-8")
+                elif f2 == 2:
+                    ltype = v2.decode("utf-8")
+                elif f2 == 7:
+                    blobs.append(parse_blob(v2))
+            if blobs:
+                out.append({"name": name, "type": ltype, "blobs": blobs})
+        elif field == 2 and wt == 2:           # V1LayerParameter
+            name, tcode = "", -1
+            blobs = []
+            for f2, wt2, v2 in iter_fields(val):
+                if f2 == 4:
+                    name = v2.decode("utf-8")
+                elif f2 == 5:
+                    tcode = v2
+                elif f2 == 6:
+                    blobs.append(parse_blob(v2))
+            if blobs:
+                out.append({"name": name,
+                            "type": _V1_TYPES.get(tcode, str(tcode)),
+                            "blobs": blobs})
+    return out
+
+
+# ---- blob -> framework-layout key mapping ---------------------------------
+
+def caffe_to_keys(layers: List[Dict], rgb_flip: bool = True) -> Dict[str, np.ndarray]:
+    """{'<layer>.<tag>': array} in this framework's layouts
+    (conv HWIO, fullc (in,out); see tools/import_weights.py)."""
+    out: Dict[str, np.ndarray] = {}
+    first_conv = True
+    for lp in layers:
+        name, ltype, blobs = lp["name"], lp["type"], lp["blobs"]
+        if ltype == "Convolution":
+            w = blobs[0]
+            if w.ndim != 4:
+                raise ValueError(f"{name}: conv blob0 has shape {w.shape}")
+            if rgb_flip and first_conv and w.shape[1] == 3:
+                w = w[:, ::-1]                 # BGR -> RGB (convert.cpp:118)
+            first_conv = False
+            out[name + ".wmat"] = np.ascontiguousarray(
+                w.transpose(2, 3, 1, 0))       # OIHW -> HWIO
+            if len(blobs) > 1:
+                out[name + ".bias"] = blobs[1].reshape(-1)
+        elif ltype == "InnerProduct":
+            w = blobs[0]
+            if w.ndim == 4:                    # legacy (1,1,out,in)
+                w = w.reshape(w.shape[-2], w.shape[-1])
+            out[name + ".wmat"] = np.ascontiguousarray(w.T)
+            if len(blobs) > 1:
+                out[name + ".bias"] = blobs[1].reshape(-1)
+        elif ltype == "BatchNorm":
+            sf = float(blobs[2].reshape(-1)[0]) if len(blobs) > 2 else 1.0
+            sf = sf if sf != 0.0 else 1.0
+            out[name + ".running_exp"] = blobs[0].reshape(-1) / sf
+            out[name + ".running_var"] = blobs[1].reshape(-1) / sf
+        elif ltype == "Scale":
+            out[name + ".wmat"] = blobs[0].reshape(-1)
+            if len(blobs) > 1:
+                out[name + ".bias"] = blobs[1].reshape(-1)
+        # other blob-carrying types are skipped (reference prints
+        # "Ignoring layer", convert.cpp:143)
+    return out
+
+
+def main(argv=None):
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from import_weights import import_weights
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("config")
+    ap.add_argument("source")
+    ap.add_argument("output")
+    ap.add_argument("--map", action="append", default=[], metavar="SRC=DST")
+    ap.add_argument("--strict", action="store_true")
+    ap.add_argument("--no-rgb-flip", action="store_true")
+    args = ap.parse_args(argv)
+    rename = dict(m.split("=", 1) for m in args.map)
+    import_weights(args.config, args.source, args.output, fmt="caffe",
+                   rename=rename, strict=args.strict,
+                   rgb_flip=not args.no_rgb_flip)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

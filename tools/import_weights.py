@@ -14,6 +14,8 @@ Name conventions:
   * torch: keys are ``<layer>.weight`` / ``<layer>.bias``; Linear weights
     (out,in) are transposed to (in,out), Conv2d weights (out,in,kh,kw)
     are transposed to HWIO automatically.
+  * caffe: ``.caffemodel`` protobufs parse without a Caffe build
+    (tools/import_caffe.py); BatchNorm running stats land in layer state.
   * ``--map src=dst`` renames source layers (repeatable).
 
 Usage:
@@ -89,11 +91,17 @@ def resolve_key(key: str, layer_names, rename):
 
 def import_weights(cfg_path: str, src_path: str, out_path: str,
                    fmt: str = "", rename=None, strict: bool = False,
-                   verbose: bool = True) -> int:
+                   verbose: bool = True, rgb_flip: bool = True) -> int:
     """Returns the number of imported tensors."""
     if not fmt:
-        fmt = "torch" if src_path.endswith((".pt", ".pth")) else "npz"
-    weights = load_torch(src_path) if fmt == "torch" else load_npz(src_path)
+        fmt = ("torch" if src_path.endswith((".pt", ".pth"))
+               else "caffe" if src_path.endswith(".caffemodel") else "npz")
+    if fmt == "caffe":
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from import_caffe import caffe_to_keys, parse_caffemodel
+        weights = caffe_to_keys(parse_caffemodel(src_path), rgb_flip=rgb_flip)
+    else:
+        weights = load_torch(src_path) if fmt == "torch" else load_npz(src_path)
     rename = dict(rename or {})
 
     cfg = parse_config_file(cfg_path)
@@ -103,6 +111,7 @@ def import_weights(cfg_path: str, src_path: str, out_path: str,
     layer_names = set(tr.param_layer_names())
 
     updates = {}
+    state_updates = {}
     for key, arr in sorted(weights.items()):
         resolved = resolve_key(key, layer_names, rename)
         if resolved is None:
@@ -113,12 +122,17 @@ def import_weights(cfg_path: str, src_path: str, out_path: str,
                 print(msg)
             continue
         layer, tag = resolved
+        cur, is_state = None, False
         try:
             cur = tr.get_weight(layer, tag)
         except (KeyError, TypeError):
-            cur = None
+            try:                       # state entries (BN running stats)
+                cur = tr.get_state(layer, tag)
+                is_state = True
+            except (KeyError, TypeError):
+                cur = None
         if cur is None:
-            msg = f"skip {key}: layer {layer!r} has no param {tag!r}"
+            msg = f"skip {key}: layer {layer!r} has no param/state {tag!r}"
             if strict:
                 raise KeyError(msg)
             if verbose:
@@ -132,15 +146,18 @@ def import_weights(cfg_path: str, src_path: str, out_path: str,
             if verbose:
                 print(msg)
             continue
-        updates[(layer, tag)] = arr
+        (state_updates if is_state else updates)[(layer, tag)] = arr
         if verbose:
             print(f"copied {key} -> {layer}.{tag} {arr.shape}")
     # single gather + placement for the whole batch of tensors
     tr.set_weights(updates)
+    if state_updates:
+        tr.set_states(state_updates)
     tr.save_model(out_path)
+    n = len(updates) + len(state_updates)
     if verbose:
-        print(f"imported {len(updates)} tensors -> {out_path}")
-    return len(updates)
+        print(f"imported {n} tensors -> {out_path}")
+    return n
 
 
 def main(argv=None):
@@ -148,7 +165,7 @@ def main(argv=None):
     ap.add_argument("config")
     ap.add_argument("source")
     ap.add_argument("output")
-    ap.add_argument("--format", choices=("npz", "torch"), default="")
+    ap.add_argument("--format", choices=("npz", "torch", "caffe"), default="")
     ap.add_argument("--map", action="append", default=[],
                     metavar="SRC=DST", help="rename source layer SRC to DST")
     ap.add_argument("--strict", action="store_true",
