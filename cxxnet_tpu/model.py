@@ -169,6 +169,147 @@ class Network:
         return ForwardResult(loss=total_loss, state=new_state,
                              nodes=node_map, out=out)
 
+    # -- pipeline staging (config-driven pp, parallel/pipeline.py) ---------
+    def stage_partition(self, n_stages: int) -> List[Tuple[int, int]]:
+        """Partition layers into ``n_stages`` contiguous [lo, hi) ranges
+        from per-layer ``stage = k`` config annotations (a layer without
+        one inherits the previous layer's stage). Loss layers are excluded
+        from the pipeline body — they run on the reassembled full batch.
+        Validates: stages non-decreasing and covering 0..S-1, no
+        cross-stage skip connections (every input of a stage-k layer is
+        produced in stage k, or is the single boundary node from stage
+        k-1), identical boundary activation shapes, and no stateful layers
+        in the body (BN running stats / MoE aux-loss don't commute with
+        the microbatch schedule)."""
+        g = self.graph
+        n_body = len(g.layers)
+        while n_body and self.layers[n_body - 1].is_loss:
+            n_body -= 1
+        for li in range(n_body):
+            if self.layers[li].is_loss:
+                raise ValueError(
+                    "pipeline_parallel: loss layers must come last")
+        stages = []
+        cur = 0
+        for li in range(n_body):
+            for k, v in g.layers[li].cfg:
+                if k == "stage":
+                    nxt = int(v)
+                    if nxt < cur or nxt > cur + 1:
+                        raise ValueError(
+                            f"pipeline stage ids must be contiguous and "
+                            f"non-decreasing; layer {g.layers[li].name!r} "
+                            f"jumps {cur} -> {nxt}")
+                    cur = nxt
+            stages.append(cur)
+        if cur != n_stages - 1:
+            raise ValueError(
+                f"config declares stages 0..{cur} but pipeline_parallel = "
+                f"{n_stages}")
+        ranges: List[Tuple[int, int]] = []
+        lo = 0
+        for s in range(n_stages):
+            hi = lo
+            while hi < n_body and stages[hi] == s:
+                hi += 1
+            if hi == lo:
+                raise ValueError(f"pipeline stage {s} has no layers")
+            ranges.append((lo, hi))
+            lo = hi
+        # validations over the partition
+        node_stage = {0: 0}
+        for i in range(g.extra_data_num):
+            node_stage[1 + i] = 0
+        boundary_nodes = []
+        for s, (lo, hi) in enumerate(ranges):
+            boundary = g.layers[lo - 1].nindex_out[0] if s > 0 else None
+            boundary_nodes.append(boundary)
+            for li in range(lo, hi):
+                layer, spec = self.layers[li], g.layers[li]
+                if layer.has_state or layer.init_state(
+                        self._in_shapes_of[li]):
+                    raise ValueError(
+                        f"pipeline_parallel: stateful layer "
+                        f"{spec.name!r} ({spec.type}) is not supported in "
+                        f"the pipeline body")
+                for ni in spec.nindex_in:
+                    src = node_stage.get(ni)
+                    if src is None:
+                        raise ValueError(
+                            f"layer {spec.name!r}: input node produced in "
+                            "a later stage")
+                    if src != s and not (src == s - 1 and ni == boundary):
+                        raise ValueError(
+                            f"pipeline_parallel: layer {spec.name!r} in "
+                            f"stage {s} reads a node from stage {src} that "
+                            "is not the stage boundary — cross-stage skip "
+                            "connections are not pipelinable")
+                for ni in spec.nindex_out:
+                    node_stage[ni] = s
+        # boundary shapes must be uniform (they share one ring register)
+        shapes = {self.node_shapes[g.layers[hi - 1].nindex_out[0]]
+                  for _, hi in ranges[:-1]}
+        if len(shapes) > 1:
+            raise ValueError(
+                f"pipeline_parallel: stage boundary shapes differ {shapes};"
+                " all boundaries share one ppermute register")
+        return ranges
+
+    def apply_stage(self, lo: int, hi: int, params: Params, x: jax.Array,
+                    rng: jax.Array, train: bool) -> jax.Array:
+        """Run layers [lo, hi) on one microbatch: ``x`` is the raw data
+        (lo == 0) or the boundary activation. Returns the range's final
+        node value. Stage layers are stateless (enforced by
+        stage_partition)."""
+        g = self.graph
+        nodes: Dict[int, jax.Array] = {}
+        if lo == 0:
+            nodes[0] = x
+        else:
+            nodes[g.layers[lo - 1].nindex_out[0]] = x
+        for li in range(lo, hi):
+            spec, layer = g.layers[li], self.layers[li]
+            ctx = ApplyCtx(train=train, rng=jax.random.fold_in(rng, li),
+                           compute_dtype=self.compute_dtype)
+            inputs = [nodes[ni] for ni in spec.nindex_in]
+            outputs, _ = layer.apply(params.get(layer.name, {}), {}, inputs,
+                                     ctx)
+            for ni, out in zip(spec.nindex_out, outputs):
+                nodes[ni] = out
+        return nodes[g.layers[hi - 1].nindex_out[0]]
+
+    def apply_tail(self, body_hi: int, params: Params, state: NetState,
+                   top: jax.Array, label: Optional[jax.Array],
+                   mask: jax.Array, rng: jax.Array,
+                   train: bool) -> ForwardResult:
+        """Run the loss layers [body_hi, end) on the full-batch pipeline
+        output ``top`` (they are row-wise, so GSPMD batch sharding
+        applies)."""
+        g = self.graph
+        nodes: Dict[int, jax.Array] = {
+            g.layers[body_hi - 1].nindex_out[0]: top}
+        new_state: NetState = dict(state)
+        total_loss = jnp.zeros((), jnp.float32)
+        for li in range(body_hi, len(g.layers)):
+            spec, layer = g.layers[li], self.layers[li]
+            ctx = ApplyCtx(train=train, rng=jax.random.fold_in(rng, li),
+                           compute_dtype=self.compute_dtype)
+            inputs = [nodes[ni] for ni in spec.nindex_in]
+            outputs, lstate_out = layer.apply(
+                params.get(layer.name, {}), new_state.get(layer.name, {}),
+                inputs, ctx)
+            if lstate_out:
+                new_state[layer.name] = lstate_out
+            for ni, out in zip(spec.nindex_out, outputs):
+                nodes[ni] = out
+            if layer.is_loss and label is not None:
+                a, b = g.label_slice(layer.target)
+                total_loss = total_loss + layer.loss(
+                    outputs, label[:, a:b].astype(jnp.float32), mask)
+        out = nodes[g.layers[-1].nindex_out[0]]
+        return ForwardResult(loss=total_loss, state=new_state, nodes=None,
+                             out=out)
+
     def node_value(self, result: ForwardResult, name: str) -> jax.Array:
         """Look up a captured node by name or 'top[-k]' style index."""
         assert result.nodes is not None, "apply(capture_nodes=True) required"

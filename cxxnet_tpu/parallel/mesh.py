@@ -55,6 +55,7 @@ class MeshContext:
     data_axis: str = "data"
     model_axis: str = "model"
     seq_axis: str = "seq"
+    pipe_axis: str = "pipe"
 
     @property
     def num_devices(self) -> int:
@@ -67,6 +68,10 @@ class MeshContext:
     @property
     def seq_parallel(self) -> int:
         return self.mesh.shape.get(self.seq_axis, 1)
+
+    @property
+    def pipeline_parallel(self) -> int:
+        return self.mesh.shape.get(self.pipe_axis, 1)
 
     # -- shardings ---------------------------------------------------------
     def replicated(self) -> NamedSharding:
@@ -204,21 +209,24 @@ def allreduce_metric_pairs(pairs):
 def make_mesh_context(dev: str = "tpu",
                       devices: Optional[Sequence] = None,
                       model_parallel: int = 1,
-                      seq_parallel: int = 1) -> MeshContext:
+                      seq_parallel: int = 1,
+                      pipeline_parallel: int = 1) -> MeshContext:
     """Build the mesh. ``dev`` is the config device spec; ``devices``
     overrides explicitly (used by tests to build CPU meshes). Axes:
-    ``('data', 'seq', 'model')`` — seq/model default to size 1 so pure
-    data-parallel code is unaffected."""
+    ``('data', 'pipe', 'seq', 'model')`` — pipe/seq/model default to size 1
+    so pure data-parallel code is unaffected."""
     if devices is None:
         idx = parse_device_spec(dev)
         all_devs = jax.devices()
         devices = all_devs if idx is None else [all_devs[i] for i in idx]
     n = len(devices)
-    if n % (model_parallel * seq_parallel):
+    denom = model_parallel * seq_parallel * pipeline_parallel
+    if n % denom:
         raise ValueError(
             f"{n} devices not divisible by model_parallel={model_parallel} "
-            f"x seq_parallel={seq_parallel}")
+            f"x seq_parallel={seq_parallel} "
+            f"x pipeline_parallel={pipeline_parallel}")
     arr = np.asarray(devices).reshape(
-        n // (model_parallel * seq_parallel), seq_parallel, model_parallel)
-    mesh = Mesh(arr, ("data", "seq", "model"))
+        n // denom, pipeline_parallel, seq_parallel, model_parallel)
+    mesh = Mesh(arr, ("data", "pipe", "seq", "model"))
     return MeshContext(mesh=mesh)

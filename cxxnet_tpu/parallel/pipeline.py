@@ -85,6 +85,240 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
     return out.reshape(B, *out.shape[2:])
 
 
+def pipeline_apply_stages(stage_fns, params: Any, x: jax.Array, aux: Any,
+                          axis_name: str, n_microbatch: int,
+                          boundary_sd, out_sd,
+                          extra_vary_axes=(),
+                          grad_sum_axes=()):
+    """GPipe schedule over HETEROGENEOUS stages (the config-driven path).
+
+    ``stage_fns``: S callables. ``f_k(params, mb_input, m)`` — ``m`` is
+    the microbatch index (fold it into any dropout rng so masks differ
+    per microbatch). ``f_0`` ingests raw data microbatches; middle stages
+    ingest the boundary activation; the LAST stage is
+    ``f_{S-1}(params, inp, aux_mb, m) -> (y, scalar)`` — it also receives
+    its microbatch's slice of ``aux`` (labels/mask, any pytree with
+    leading dim M) and returns the final output plus a per-microbatch
+    scalar (the loss). Returns ``(out, scalar_sum)`` where ``scalar_sum``
+    accumulates the last stage's scalars over all M microbatches.
+
+    Keeping the loss INSIDE the last stage matters: it makes every
+    collective in the step data-dependent on the ring, so no independent
+    all-reduce can interleave with the ppermutes (concurrent independent
+    collectives deadlock the CPU backend's in-process communicator and
+    serialize badly on real ICI).
+
+    All inter-stage boundaries share one activation shape/dtype
+    (``boundary_sd``, without the microbatch dim) — the ring register
+    ``lax.ppermute`` rotates; the final output (``out_sd``) may differ.
+    Device p selects its own stage with ``lax.switch``, so each device
+    executes exactly one stage's FLOPs per tick. ``params`` is the full
+    (replicated) param tree — stage memory sharding is the stacked
+    homogeneous path above (``pipeline_apply``); here throughput scales
+    and per-device *activation* memory drops to one microbatch.
+
+    The backward pass is a HAND-WRITTEN reverse schedule (custom_vjp):
+    cotangents enter at the last stage and ride the inverted ring while
+    each device transposes its own stage (recomputing stage activations
+    from the saved tick-entry registers — remat, not storage). Plain
+    autodiff is not an option: transposing a device-index ``lax.switch``
+    whose branches contain pvary boundaries inserts collectives into SOME
+    branches only, so devices diverge in collective order and deadlock.
+    ``grad_sum_axes``: extra axes (e.g. the data axis) to sum the param
+    cotangent over so it leaves the vjp replicated, like the params came
+    in. Not twice-differentiable (the custom backward is primal-only).
+    """
+    S = len(stage_fns)
+    M = n_microbatch
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by n_microbatch {M}")
+    mb = B // M
+    T = M + S - 1
+    perm = [(i, (i + 1) % S) for i in range(S)]
+    perm_inv = [(i, (i - 1) % S) for i in range(S)]
+    axes = (axis_name,) + tuple(extra_vary_axes)
+    reg_shape = (mb,) + tuple(boundary_sd.shape)
+    out_shape = (mb,) + tuple(out_sd.shape)
+
+    def pvary(a, want=None):
+        # vary only over the axes the value is not already varying on
+        # (pcast rejects mixed-state axis lists)
+        want = axes if want is None else want
+        try:
+            have = set(jax.typeof(a).vma)
+        except Exception:
+            have = set()
+        need = tuple(ax for ax in want if ax not in have)
+        if not need:
+            return a
+        try:
+            return lax.pcast(a, need, to="varying")
+        except (AttributeError, TypeError):
+            return lax.pvary(a, need)
+
+    def aux_at(aux_, m):
+        return jax.tree_util.tree_map(
+            lambda a: a[jnp.clip(m, 0, M - 1)], aux_)
+
+    def last_call(p, inp, aux_, m):
+        y, scalar = stage_fns[S - 1](p, inp, aux_at(aux_, m), m)
+        return y, jnp.asarray(scalar, jnp.float32)
+
+    def forward(params, x, aux_):
+        me = lax.axis_index(axis_name)
+        xs = x.reshape(M, mb, *x.shape[1:])
+        reg0 = pvary(jnp.zeros(reg_shape, boundary_sd.dtype))
+        out0 = pvary(jnp.zeros((M,) + out_shape, out_sd.dtype))
+        loss0 = pvary(jnp.zeros((), jnp.float32))
+
+        def tick(carry, t):
+            reg, out, loss = carry
+            feed = jnp.where(t < M, t, M - 1)
+            zero_reg = pvary(jnp.zeros(reg_shape, boundary_sd.dtype))
+            zero_out = pvary(jnp.zeros(out_shape, out_sd.dtype))
+
+            def branch(k):
+                def run(reg_in):
+                    inp = pvary(xs[feed]) if k == 0 else reg_in
+                    if k == S - 1:
+                        y, scalar = last_call(params, inp, aux_,
+                                              t - (S - 1))
+                        return (zero_reg, y.astype(zero_out.dtype),
+                                pvary(scalar))
+                    y = stage_fns[k](params, inp, t - k)
+                    return (y.astype(zero_reg.dtype), zero_out,
+                            pvary(jnp.zeros((), jnp.float32)))
+                return run
+
+            reg_new, bank, scalar = lax.switch(
+                me, [branch(k) for k in range(S)], reg)
+            done_idx = jnp.clip(t - (S - 1), 0, M - 1)
+            live = jnp.logical_and(me == S - 1, t >= S - 1)
+            out = lax.cond(
+                live,
+                lambda o: lax.dynamic_update_slice(
+                    o, bank[None].astype(o.dtype),
+                    (done_idx,) + (0,) * (o.ndim - 1)),
+                lambda o: o, out)
+            loss = loss + jnp.where(live, scalar, 0.0)
+            reg_next = lax.ppermute(reg_new, axis_name, perm)
+            return (reg_next, out, loss), reg    # save tick-ENTRY register
+
+        (_, out, loss), regs = lax.scan(tick, (reg0, out0, loss0),
+                                        jnp.arange(T))
+        # replicate the last stage's results to every pipe member. ONE psum
+        # for both values: separate psums would be data-independent and the
+        # scheduler could interleave one with the backward ring (see the
+        # docstring's deadlock note)
+        out, loss = lax.psum(
+            (out * jnp.where(me == S - 1, 1.0, 0.0).astype(out.dtype),
+             loss), axis_name)
+        return out.reshape(B, *out.shape[2:]), loss, regs
+
+    @jax.custom_vjp
+    def run(params, x, aux_):
+        out, loss, _ = forward(params, x, aux_)
+        return out, loss
+
+    def run_fwd(params, x, aux_):
+        out, loss, regs = forward(params, x, aux_)
+        return (out, loss), (params, x, aux_, regs)
+
+    def run_bwd(res, cot):
+        dout, dloss = cot                  # dloss replicated (loss is)
+        params, x, aux_, regs = res
+        me = lax.axis_index(axis_name)
+        xs = x.reshape(M, mb, *x.shape[1:])
+        dout_m = dout.reshape(M, mb, *dout.shape[1:])
+        zero_dx = jnp.zeros(xs.shape[1:], xs.dtype)
+        zero_db = jnp.zeros(reg_shape, boundary_sd.dtype)
+        dreg0 = pvary(jnp.zeros(reg_shape, boundary_sd.dtype))
+        dxs0 = pvary(jnp.zeros_like(xs))
+        dp0 = jax.tree_util.tree_map(lambda a: pvary(jnp.zeros_like(a)),
+                                     params)
+
+        # params must be FULLY VARYING before entering the per-branch vjps:
+        # differentiating a function that reads invariant params inside a
+        # varying computation makes the transpose insert a psum_invariant
+        # at the boundary — inside the switch branch — and branch-local
+        # collectives deadlock (devices take different branches). With
+        # varying params the vjp is collective-free and we sum explicitly
+        # at the end.
+        pv_params = jax.tree_util.tree_map(pvary, params)
+
+        def rtick(carry, t):
+            dreg, dp_acc, dxs = carry
+            feed = jnp.where(t < M, t, M - 1)
+            m_last = t - (S - 1)
+            live_last = jnp.logical_and(m_last >= 0, m_last < M)
+            dy_last = jnp.where(
+                live_last, dout_m[jnp.clip(m_last, 0, M - 1)],
+                0).astype(out_sd.dtype)
+            ds_last = jnp.where(live_last, dloss, 0.0)
+
+            def branch(k):
+                def run_b(dreg_in):
+                    # vary inputs OUTSIDE the vjp'd function — a pvary
+                    # inside it would transpose into a psum confined to
+                    # this branch, and branch-local collectives diverge
+                    # across devices (the deadlock this custom vjp exists
+                    # to avoid). With fully-varying inputs the primal
+                    # outputs are fully varying, so cotangent types match
+                    # without any pvary in the traced function.
+                    inp = pvary(xs[feed] if k == 0 else regs[t])
+                    if k == S - 1:
+                        _, vjp = jax.vjp(
+                            lambda pp, xx: last_call(pp, xx, aux_, m_last),
+                            pv_params, inp.astype(boundary_sd.dtype
+                                                  if S > 1 else xs.dtype))
+                        dp, dinp = vjp((pvary(dy_last),
+                                        pvary(jnp.float32(ds_last))))
+                    else:
+                        m = t - k
+                        live = jnp.logical_and(m >= 0, m < M)
+                        dy = jnp.where(live, pvary(dreg_in), 0)
+                        _, vjp = jax.vjp(
+                            lambda pp, xx: stage_fns[k](pp, xx, m).astype(
+                                dy.dtype),
+                            pv_params, inp.astype(
+                                xs.dtype if k == 0 else boundary_sd.dtype))
+                        dp, dinp = vjp(dy)
+                    if k == 0:
+                        return (dp, dinp.astype(zero_dx.dtype),
+                                pvary(zero_db))
+                    return (dp, pvary(zero_dx), dinp.astype(zero_db.dtype))
+                return run_b
+
+            dp_t, dx_t, db_t = lax.switch(
+                me, [branch(k) for k in range(S)], dreg)
+            dp_acc = jax.tree_util.tree_map(jnp.add, dp_acc, dp_t)
+            # stage 0 banks the data cotangent for microbatch `feed`
+            # (dx_t is zero on every other device and on drained ticks)
+            dxs = lax.dynamic_update_slice(
+                dxs, (dxs[feed] + dx_t)[None].astype(dxs.dtype),
+                (feed,) + (0,) * (dxs.ndim - 1))
+            dreg = lax.ppermute(db_t, axis_name, perm_inv)
+            return (dreg, dp_acc, dxs), None
+
+        (_, dp_acc, dxs), _ = lax.scan(
+            rtick, (dreg0, dp0, dxs0), jnp.arange(T - 1, -1, -1))
+        # params entered replicated: sum the per-device stage contributions
+        # over the pipe axis (and the data axes) so the cotangent leaves
+        # replicated too. The pipe-axis psum covers dp AND dxs in one call,
+        # and the data-axis psum consumes its result — every collective in
+        # the backward chains, none can interleave with the ring.
+        dp_acc, dxs = lax.psum((dp_acc, dxs), axis_name)
+        if grad_sum_axes:
+            dp_acc = lax.psum(dp_acc, tuple(grad_sum_axes))
+        dx = dxs.reshape(x.shape).astype(x.dtype)
+        daux = jax.tree_util.tree_map(jnp.zeros_like, aux_)
+        return dp_acc, dx, daux
+
+    run.defvjp(run_fwd, run_bwd)
+    return run(params, x, aux)
+
+
 def pipeline_sharded(mesh: Mesh, stage_fn, stage_params, x: jax.Array,
                      n_microbatch: int, pipe_axis: str = "pipe") -> jax.Array:
     """One-call pipeline: stage_params' leading axis shards over

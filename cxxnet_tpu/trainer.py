@@ -74,10 +74,17 @@ class Trainer:
         dev = gp("dev", "")
         model_parallel = int(gp("model_parallel", "1"))
         seq_parallel = int(gp("seq_parallel", "1"))
+        pipeline_parallel = int(gp("pipeline_parallel", "1"))
         self.mesh = mesh_ctx or make_mesh_context(
             dev or "tpu", model_parallel=model_parallel,
-            seq_parallel=seq_parallel)
+            seq_parallel=seq_parallel,
+            pipeline_parallel=pipeline_parallel)
         self._sp = self.mesh.seq_parallel
+        self._pp = self.mesh.pipeline_parallel
+        # microbatch count for the GPipe schedule (reference has no analog;
+        # update_period is the closest — but that serializes, this overlaps)
+        self._pp_microbatch = int(gp("pipeline_microbatch",
+                                     str(max(self._pp, 1))))
         self.optimizer = create_optimizer(self.graph.updater_type, cfg)
         # metric bindings (reference nnet_impl-inl.hpp:73-83)
         self.metric = MetricSet()
@@ -124,6 +131,30 @@ class Trainer:
                 f"degree {self.mesh.data_parallel}")
         if self._sp > 1:
             self._check_seq_parallel_ok()
+        self._pp_ranges = None
+        if self._pp > 1:
+            if self._sp > 1:
+                raise ValueError(
+                    "pipeline_parallel with seq_parallel is not supported")
+            if self.mesh.model_parallel > 1:
+                raise ValueError(
+                    "pipeline_parallel with model_parallel is not "
+                    "supported yet (the pp step would silently replicate "
+                    "the TP axis)")
+            if self.graph.extra_data_num:
+                raise ValueError("pipeline_parallel does not support "
+                                 "extra_data")
+            if any(n is not None for n in self._metric_nodes):
+                raise ValueError("pipeline_parallel supports metrics on the "
+                                 "top node only")
+            if self.batch_size % (self.mesh.data_parallel
+                                  * self._pp_microbatch):
+                raise ValueError(
+                    f"batch_size {self.batch_size} not divisible by "
+                    f"data_parallel x pipeline_microbatch = "
+                    f"{self.mesh.data_parallel}x{self._pp_microbatch}")
+            # validates staging and fails fast on unpipelinable graphs
+            self._pp_ranges = self.net.stage_partition(self._pp)
 
     # Layers whose apply is correct on a local sequence shard under
     # shard_map (mha switches to the ring path via ctx.seq_axis). posembed
@@ -378,6 +409,130 @@ class Trainer:
             out_specs=(rep, rep, rep, rep, rep, top_spec, rep))
         return jax.jit(wrapped, donate_argnums=(0, 1, 2, 3))
 
+    def _pp_probe_shapes(self, data_shape):
+        """Per-microbatch boundary and final-output ShapeDtypeStructs for
+        the pipeline ring register, via eval_shape over the stage chain."""
+        mb = data_shape[0] // self.mesh.data_parallel // self._pp_microbatch
+        rng0 = jax.random.PRNGKey(0)
+        W = self.graph.label_width()
+        sd = jax.ShapeDtypeStruct((mb,) + tuple(data_shape[1:]), jnp.float32)
+        boundary = None
+        for lo, hi in self._pp_ranges[:-1]:
+            sd = jax.eval_shape(
+                lambda p, x, _lo=lo, _hi=hi: self.net.apply_stage(
+                    _lo, _hi, p, x, rng0, True), self.params, sd)
+            if boundary is None:
+                boundary = sd
+        lo, hi = self._pp_ranges[-1]
+        n_body = hi
+        lab = jax.ShapeDtypeStruct((mb, W), jnp.float32)
+        msk = jax.ShapeDtypeStruct((mb,), jnp.float32)
+
+        def last(p, x, label, mask):
+            y = self.net.apply_stage(lo, hi, p, x, rng0, True)
+            res = self.net.apply_tail(n_body, p, {}, y, label, mask, rng0,
+                                      True)
+            return res.out
+        out = jax.eval_shape(last, self.params, sd, lab, msk)
+        strip = lambda a: jax.ShapeDtypeStruct(tuple(a.shape)[1:], a.dtype)
+        return strip(boundary), strip(out)
+
+    def _pp_pipeline_fn(self, data_shape, train: bool):
+        """Local GPipe body (runs under shard_map): the stage schedule over
+        the 'pipe' axis on this device's batch rows, with the loss layers
+        folded into the LAST stage so all collectives chain off the ring
+        (parallel/pipeline.py pipeline_apply_stages)."""
+        from .parallel.pipeline import pipeline_apply_stages
+        net, ranges = self.net, self._pp_ranges
+        n_body = ranges[-1][1]
+        boundary_sd, out_sd = self._pp_probe_shapes(data_shape)
+        pipe_axis, data_axis = self.mesh.pipe_axis, self.mesh.data_axis
+        M = self._pp_microbatch
+
+        def body(p, x, label, mask, rng):
+            mb = x.shape[0] // M
+            # fold the microbatch index into the rng so dropout masks are
+            # independent across microbatches (they'd repeat otherwise)
+            fns = [
+                (lambda pp_, xx, m, _lo=lo, _hi=hi: net.apply_stage(
+                    _lo, _hi, pp_, xx, jax.random.fold_in(rng, m), train))
+                for lo, hi in ranges[:-1]]
+            lo, hi = ranges[-1]
+
+            def last_fn(pp_, xx, aux_mb, m):
+                label_mb, mask_mb = aux_mb
+                rng_m = jax.random.fold_in(rng, m)
+                y = net.apply_stage(lo, hi, pp_, xx, rng_m, train)
+                res = net.apply_tail(n_body, pp_, {}, y, label_mb, mask_mb,
+                                     rng_m, train)
+                return res.out, res.loss
+            fns.append(last_fn)
+            aux = (label.reshape(M, mb, *label.shape[1:]),
+                   mask.reshape(M, mb))
+            top, loss_sum = pipeline_apply_stages(
+                fns, p, x, aux, pipe_axis, M, boundary_sd, out_sd,
+                extra_vary_axes=(data_axis,), grad_sum_axes=(data_axis,))
+            # each microbatch loss is a mean over its mb rows -> average
+            # the M of them to match the non-pipelined per-batch loss
+            return top, loss_sum / M
+
+        return body, out_sd
+
+    def _make_pp_train_step(self, do_update: bool, data_shape):
+        """Pipeline-parallel train step. The WHOLE step body runs under one
+        shard_map over ('data','pipe'); the custom-vjp backward schedule in
+        pipeline_apply_stages produces the grads (see its docstring for why
+        plain autodiff cannot)."""
+        from jax.sharding import PartitionSpec as P
+        net, opt, period = self.net, self.optimizer, self.update_period
+        pipe_axis, data_axis = self.mesh.pipe_axis, self.mesh.data_axis
+        pipeline, out_sd = self._pp_pipeline_fn(data_shape, train=True)
+        rep = P()
+
+        def step(params, opt_state, net_state, accum, data, label, mask,
+                 rng, sched):
+            def loss_fn(p):
+                top, loss = pipeline(p, data, label, mask, rng)
+                return jax.lax.pmean(loss, data_axis), top
+            (loss, out), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            params, opt_state, accum = _apply_grads(
+                opt, period, do_update, params, opt_state, accum, grads,
+                sched)
+            return (params, opt_state, net_state, accum, loss, out,
+                    jax.random.fold_in(rng, 1))
+
+        ds = P(data_axis, *([None] * (len(data_shape) - 1)))
+        out_spec = P(data_axis, *([None] * len(out_sd.shape)))
+        wrapped = jax.shard_map(
+            step, mesh=self.mesh.mesh,
+            in_specs=(rep, rep, rep, rep, ds, P(data_axis), P(data_axis),
+                      rep, rep),
+            out_specs=(rep, rep, rep, rep, rep, out_spec, rep))
+        return jax.jit(wrapped, donate_argnums=(0, 1, 2, 3))
+
+    def _make_pp_eval_step(self, data_shape):
+        from jax.sharding import PartitionSpec as P
+        data_axis = self.mesh.data_axis
+        pipeline, out_sd = self._pp_pipeline_fn(data_shape, train=False)
+
+        def step(params, net_state, data):
+            W = self.graph.label_width()
+            label = jnp.zeros((data.shape[0], W), jnp.float32)
+            mask = jnp.ones((data.shape[0],), jnp.float32)
+            top, _ = pipeline(params, data, label, mask,
+                              jax.random.PRNGKey(0))
+            return top
+
+        ds = P(data_axis, *([None] * (len(data_shape) - 1)))
+        out_spec = P(data_axis, *([None] * len(out_sd.shape)))
+        wrapped = jax.shard_map(step, mesh=self.mesh.mesh,
+                                in_specs=(P(), P(), ds),
+                                out_specs=out_spec)
+        fn = jax.jit(wrapped)
+        return lambda params, net_state, data: {_TOP: fn(params, net_state,
+                                                         data)}
+
     def _make_train_step(self, do_update: bool):
         net, opt, period = self.net, self.optimizer, self.update_period
         needed = self._needed_nodes()
@@ -417,24 +572,44 @@ class Trainer:
                 for tag, (lr, mom) in sched.items()})
         return self._sched_cache[1]
 
+    def _get_train_step(self, do_update: bool, batch: DataBatch):
+        """Resolve (and cache) the jitted train step for the active
+        parallelism mode — one dispatch point for update() and the cost
+        probe."""
+        mode = "sp" if self._sp > 1 else "pp" if self._pp > 1 else "std"
+        key = (do_update, mode)
+        if key not in self._train_step_fns:
+            if mode == "sp":
+                fn = self._make_sp_train_step(do_update)
+            elif mode == "pp":
+                fn = self._make_pp_train_step(do_update,
+                                              np.shape(batch.data))
+            else:
+                fn = self._make_train_step(do_update)
+            self._train_step_fns[key] = fn
+        return self._train_step_fns[key]
+
     def update(self, batch: DataBatch) -> None:
         """One minibatch forward/backward(+update) — reference Update
         (nnet_impl-inl.hpp:157-202)."""
         assert self.params is not None, "call init_model() first"
         do_update = (self.sample_counter + 1) % self.update_period == 0 \
             if self.update_period > 1 else True
-        key = (do_update, self._sp > 1)
-        if key not in self._train_step_fns:
-            self._train_step_fns[key] = (
-                self._make_sp_train_step(do_update) if self._sp > 1
-                else self._make_train_step(do_update))
-        step = self._train_step_fns[key]
+        step = self._get_train_step(do_update, batch)
         mask = self._mask(batch)
         if self._rng_key is None:
             self._rng_key = jax.random.fold_in(self._base_key,
                                                self._step_count)
         accum_in = self.accum if self.update_period > 1 else {}
-        if self._sp > 1:
+        if self._pp > 1:
+            data, label = self.mesh.shard_batch(batch.data, batch.label)
+            (self.params, self.opt_state, self.net_state, accum, loss,
+             top, self._rng_key) = step(
+                 self.params, self.opt_state, self.net_state,
+                 accum_in, data, label, mask, self._rng_key,
+                 self._sched_scalars())
+            nodes = {_TOP: top}
+        elif self._sp > 1:
             data, label = self._shard_seq_batch(batch.data, batch.label)
             (self.params, self.opt_state, self.net_state, accum, loss,
              top, self._rng_key) = step(
@@ -558,6 +733,16 @@ class Trainer:
 
     def _eval_nodes(self, batch: DataBatch,
                     extract: Tuple[str, ...] = ()) -> Dict[str, jax.Array]:
+        if self._pp > 1:
+            if extract:
+                raise ValueError(
+                    "pipeline_parallel supports extraction of the top node "
+                    "only")
+            if self._eval_step_fn is None or self._eval_step_fn[0] != "pp":
+                self._eval_step_fn = (
+                    "pp", self._make_pp_eval_step(np.shape(batch.data)))
+            data = self.mesh.shard_batch(batch.data)
+            return self._eval_step_fn[1](self.params, self.net_state, data)
         if self._sp > 1:
             if extract:
                 raise ValueError(
@@ -651,16 +836,16 @@ class Trainer:
         MFU number the way the reference grounds health in GPU utilization
         (reference doc/debug_perf.md:3-5 'normally above 95%')."""
         assert self.params is not None, "call init_model() first"
-        key = (True, self._sp > 1)
-        if key not in self._train_step_fns:
-            self._train_step_fns[key] = (
-                self._make_sp_train_step(True) if self._sp > 1
-                else self._make_train_step(True))
-        step = self._train_step_fns[key]
+        step = self._get_train_step(True, batch)
         mask = self._mask(batch)
         rng = jax.random.fold_in(self._base_key, 0)
         accum_in = self.accum if self.update_period > 1 else {}
-        if self._sp > 1:
+        if self._pp > 1:
+            data, label = self.mesh.shard_batch(batch.data, batch.label)
+            lowered = step.lower(self.params, self.opt_state, self.net_state,
+                                 accum_in, data, label, mask, rng,
+                                 self._sched_scalars())
+        elif self._sp > 1:
             data, label = self._shard_seq_batch(batch.data, batch.label)
             lowered = step.lower(self.params, self.opt_state, self.net_state,
                                  accum_in, data, label, mask, rng,
