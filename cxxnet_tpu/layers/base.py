@@ -131,6 +131,10 @@ class ApplyCtx:
     # bound when the whole step runs under shard_map with the sequence
     # sharded (seq_parallel > 1): attention layers switch to the ring path
     seq_axis: Optional[str] = None
+    # bound alongside seq_axis when the batch axis is also manual in the
+    # shard_map — layers whose statistics must be global (MoE aux loss)
+    # reduce over it too
+    data_axis: Optional[str] = None
 
 
 class Layer:

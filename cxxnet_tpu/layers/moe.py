@@ -83,10 +83,17 @@ class MoELayer(Layer):
         return {"_aux_loss": jnp.zeros((), jnp.float32)}
 
     def apply(self, params, state, inputs, ctx):
+        from jax import lax
         x = _seq(inputs[0]).astype(ctx.compute_dtype)   # (B, T, E)
         B, T, E = x.shape
         X = self.num_expert
-        C = max(1, int(T / X * self.capacity_factor * self.topk))
+        # Under sequence parallelism (ctx.seq_axis bound by shard_map) the
+        # routing is GLOBAL: capacity comes from the global token count and
+        # position-in-expert offsets are exchanged across shards, so token
+        # dropping matches the sp=1 run exactly (not just statistically).
+        sp_ax = ctx.seq_axis
+        sp = lax.psum(1, sp_ax) if sp_ax is not None else 1
+        C = max(1, int(T * sp / X * self.capacity_factor * self.topk))
 
         logits = jnp.einsum("bte,ex->btx", x.astype(jnp.float32),
                             params["router"]["wmat"].astype(jnp.float32))
@@ -108,13 +115,26 @@ class MoELayer(Layer):
 
         # position-in-expert via cumulative sum over tokens; tokens past the
         # capacity C are dropped (standard Switch behavior, keeps shapes
-        # static for XLA)
+        # static for XLA). prev_count carries the GLOBAL per-expert fill
+        # across selection rounds.
         dispatch = jnp.zeros((B, T, X, C), jnp.float32)
         combine = jnp.zeros((B, T, X, C), jnp.float32)
         prev_count = jnp.zeros((B, X), jnp.float32)
         for oh, gate in sel:
-            pos = jnp.cumsum(oh, axis=1) - oh + prev_count[:, None, :]
-            prev_count = prev_count + jnp.sum(oh, axis=1)
+            local_count = jnp.sum(oh, axis=1)            # (B, X)
+            if sp_ax is not None:
+                # earlier shards' tokens occupy earlier expert slots
+                all_counts = lax.all_gather(local_count, sp_ax)  # (sp,B,X)
+                before = (jnp.arange(sp) < lax.axis_index(sp_ax))
+                shard_off = jnp.einsum(
+                    "s,sbx->bx", before.astype(jnp.float32), all_counts)
+                round_total = jnp.sum(all_counts, axis=0)
+            else:
+                shard_off = jnp.zeros_like(local_count)
+                round_total = local_count
+            base = prev_count + shard_off
+            pos = jnp.cumsum(oh, axis=1) - oh + base[:, None, :]
+            prev_count = prev_count + round_total
             pos_in = jnp.sum(pos * oh, axis=-1)          # (B, T)
             keep = (pos_in < C).astype(jnp.float32) * jnp.sum(oh, axis=-1)
             slot = jax.nn.one_hot(pos_in.astype(jnp.int32), C,
@@ -123,9 +143,16 @@ class MoELayer(Layer):
             dispatch = dispatch + d
             combine = combine + d * gate[..., None, None]
 
-        # dispatch -> per-expert capacity buffers, expert FFN, combine back
+        # dispatch -> per-expert capacity buffers, expert FFN, combine back.
+        # Under sp each shard contributes its tokens' (disjoint) slots and
+        # the buffers are summed across shards — the all-to-all analog —
+        # then every shard runs the expert FFN on the global buffers (the
+        # expert compute is replicated across seq shards; combine is local).
         ex_in = jnp.einsum("btxc,bte->bxce", dispatch,
-                           x.astype(jnp.float32)).astype(ctx.compute_dtype)
+                           x.astype(jnp.float32))
+        if sp_ax is not None:
+            ex_in = lax.psum(ex_in, sp_ax)
+        ex_in = ex_in.astype(ctx.compute_dtype)
         h = jnp.einsum("bxce,xef->bxcf", ex_in,
                        params["h"]["wmat"].astype(ctx.compute_dtype))
         h = h + params["h"]["bias"].astype(ctx.compute_dtype)[None, :, None, :]
@@ -137,8 +164,14 @@ class MoELayer(Layer):
                          y.astype(jnp.float32)).astype(ctx.compute_dtype)
 
         # load-balance aux loss (GShard eq.4): X * mean_x(frac_tokens_x *
-        # mean_gate_x)
+        # mean_gate_x) — means over the GLOBAL token population under
+        # shard_map (both the seq AND the manual batch axis: the product
+        # of global means, not a mean of per-shard products)
         frac = jnp.mean(oh1, axis=(0, 1))                # (X,)
         mean_gate = jnp.mean(gates, axis=(0, 1))
+        for ax in (sp_ax, ctx.data_axis):
+            if ax is not None:
+                frac = lax.pmean(frac, ax)
+                mean_gate = lax.pmean(mean_gate, ax)
         aux = self.moe_loss_coef * X * jnp.sum(frac * mean_gate)
         return [_unseq(out)], {"_aux_loss": aux}

@@ -113,7 +113,8 @@ class Network:
               rng: Optional[jax.Array] = None,
               train: bool = False,
               capture_nodes: bool = False,
-              seq_axis: Optional[str] = None) -> ForwardResult:
+              seq_axis: Optional[str] = None,
+              data_axis: Optional[str] = None) -> ForwardResult:
         """One forward pass. ``data`` is NHWC (batch, y, x, c) or flat
         (batch,1,1,n); ``label`` is (batch, label_width); ``mask`` is (batch,)
         marking real rows (None = all real)."""
@@ -132,7 +133,7 @@ class Network:
         for li, (spec, layer) in enumerate(zip(g.layers, self.layers)):
             ctx = ApplyCtx(train=train, rng=jax.random.fold_in(rng, li),
                            compute_dtype=self.compute_dtype,
-                           seq_axis=seq_axis)
+                           seq_axis=seq_axis, data_axis=data_axis)
             inputs = [nodes[ni] for ni in spec.nindex_in]
             lparams = params.get(layer.name, {})
             lstate = new_state.get(layer.name, {})
@@ -140,7 +141,8 @@ class Network:
                 def _fn(lp, ls, rng_, *ins, _layer=layer, _ctx=ctx):
                     c = ApplyCtx(train=_ctx.train, rng=rng_,
                                  compute_dtype=_ctx.compute_dtype,
-                                 seq_axis=_ctx.seq_axis)
+                                 seq_axis=_ctx.seq_axis,
+                                 data_axis=_ctx.data_axis)
                     return _layer.apply(lp, ls, list(ins), c)
                 outputs, lstate_out = jax.checkpoint(_fn)(
                     lparams, lstate, ctx.rng, *inputs)

@@ -40,6 +40,15 @@ _METRIC_RE = re.compile(r"^metric(?:\[([^,\]]+)(?:,([^\]]+))?\])?$")
 _TOP = "!top"
 
 
+def _collect_nodes(res, needed):
+    """Assemble the step's node outputs: the top node plus any captured
+    metric/extract-bound nodes — shared by every train/eval step builder."""
+    nodes = {_TOP: res.out}
+    if needed:
+        nodes.update({n: res.nodes[n] for n in needed})
+    return nodes
+
+
 def _apply_grads(opt, period, do_update, params, opt_state, accum, grads,
                  sched):
     """Gradient accumulation (update_period) + optimizer step — shared by
@@ -172,9 +181,9 @@ class Trainer:
             raise ValueError(
                 f"seq_parallel: layer types {sorted(set(bad))} are not "
                 f"sequence-shardable (use rope for positions, not posembed)")
-        if self.mesh.mesh.shape[self.mesh.model_axis] != 1:
-            raise ValueError("seq_parallel with model_parallel>1 is not "
-                             "supported yet")
+        # model_parallel composes with seq_parallel: the shard_map is
+        # partial-manual (('data','seq') manual, 'model' automatic), so
+        # GSPMD still shards params/experts over 'model' inside the step
         if self.graph.extra_data_num:
             raise ValueError("seq_parallel does not support extra_data")
         c, y, S = self.graph.input_shape
@@ -193,9 +202,8 @@ class Trainer:
             raise ValueError(
                 "seq_parallel requires a single full-width label slice "
                 f"(got label_vec ranges {self.graph.label_range})")
-        if any(n is not None for n in self._metric_nodes):
-            raise ValueError(
-                "seq_parallel supports metrics on the top node only")
+        # metric[label,node] bindings on non-top nodes are supported: the
+        # sp train/eval steps capture them with (data, seq) out-specs
 
     # -- model lifecycle ---------------------------------------------------
     def _place(self, params, net_state=None, opt_state=None):
@@ -370,23 +378,32 @@ class Trainer:
         """Sequence-parallel train step: the whole step body runs under
         shard_map over the ('data','seq') mesh; mha layers take the ring
         path, gradients of replicated params are psum'd automatically by
-        shard_map's transpose, and the loss is averaged across shards.
-        Note: the per-layer RNG is replicated, so dropout masks repeat
-        across sequence shards (documented limitation)."""
+        shard_map's transpose, and the loss is averaged across shards;
+        the shard indices fold into the dropout rng so masks are
+        independent per shard."""
         from jax.sharding import PartitionSpec as P
         net, opt, period = self.net, self.optimizer, self.update_period
         seq_axis, data_axis = self.mesh.seq_axis, self.mesh.data_axis
         rep = P()
+        needed = self._needed_nodes()
+        capture = bool(needed)
 
         def step(params, opt_state, net_state, accum, data, label, mask,
                  rng, sched):
+            # decorrelate dropout across shards: fold both shard indices
+            # into the key (a replicated key would repeat masks per shard)
+            rng_l = jax.random.fold_in(
+                jax.random.fold_in(rng, jax.lax.axis_index(data_axis)),
+                jax.lax.axis_index(seq_axis))
+
             def loss_fn(p):
-                res = net.apply(p, net_state, data, label, mask, rng=rng,
-                                train=True, seq_axis=seq_axis)
+                res = net.apply(p, net_state, data, label, mask, rng=rng_l,
+                                train=True, seq_axis=seq_axis,
+                                data_axis=data_axis, capture_nodes=capture)
                 loss = jax.lax.pmean(
                     jax.lax.pmean(res.loss, seq_axis), data_axis)
-                return loss, (res.state, res.out)
-            (loss, (new_state, top)), grads = jax.value_and_grad(
+                return loss, (res.state, _collect_nodes(res, needed))
+            (loss, (new_state, nodes)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
             # layer state computed from local shards (e.g. the MoE
             # load-balance aux loss) must leave the shard_map replicated
@@ -397,16 +414,22 @@ class Trainer:
                 opt, period, do_update, params, opt_state, accum, grads,
                 sched)
             # the rng key chains device-side (no per-step host upload)
-            return (params, opt_state, new_state, accum, loss, top,
+            return (params, opt_state, new_state, accum, loss, nodes,
                     jax.random.fold_in(rng, 1))
 
-        top_spec = P(data_axis, seq_axis, None, None)
+        node_spec = P(data_axis, seq_axis, None, None)
+        nodes_spec = {k: node_spec for k in [_TOP] + needed}
+        # PARTIAL-MANUAL shard_map: only ('data','seq') go manual; the
+        # 'model' axis stays automatic, so GSPMD keeps tensor/expert
+        # parallelism (per-layer param_pspecs) working INSIDE the
+        # sequence-parallel step — this is what makes sp x tp compose
         wrapped = jax.shard_map(
             step, mesh=self.mesh.mesh,
             in_specs=(rep, rep, rep, rep,
                       P(data_axis, None, None, seq_axis),
                       P(data_axis, seq_axis), P(data_axis), rep, rep),
-            out_specs=(rep, rep, rep, rep, rep, top_spec, rep))
+            out_specs=(rep, rep, rep, rep, rep, nodes_spec, rep),
+            axis_names={data_axis, seq_axis})
         return jax.jit(wrapped, donate_argnums=(0, 1, 2, 3))
 
     def _pp_probe_shapes(self, data_shape):
@@ -544,10 +567,7 @@ class Trainer:
                 res = net.apply(p, net_state, data, label, mask,
                                 extra_data=extra, rng=rng, train=True,
                                 capture_nodes=capture)
-                nodes = {_TOP: res.out}
-                if capture:
-                    nodes.update({n: res.nodes[n] for n in needed})
-                return res.loss, (res.state, nodes)
+                return res.loss, (res.state, _collect_nodes(res, needed))
             (loss, (new_state, nodes)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
             params, opt_state, accum = _apply_grads(
@@ -612,11 +632,10 @@ class Trainer:
         elif self._sp > 1:
             data, label = self._shard_seq_batch(batch.data, batch.label)
             (self.params, self.opt_state, self.net_state, accum, loss,
-             top, self._rng_key) = step(
+             nodes, self._rng_key) = step(
                  self.params, self.opt_state, self.net_state,
                  accum_in, data, label, mask, self._rng_key,
                  self._sched_scalars())
-            nodes = {_TOP: top}
         else:
             data, label = self.mesh.shard_batch(batch.data, batch.label)
             extra = tuple(self.mesh.shard_batch(e) for e in batch.extra_data)
@@ -706,29 +725,34 @@ class Trainer:
         def step(params, net_state, data, extra):
             res = net.apply(params, net_state, data, extra_data=extra,
                             train=False, capture_nodes=capture)
-            nodes = {_TOP: res.out}
-            if capture:
-                nodes.update({n: res.nodes[n] for n in needed})
-            return nodes
+            return _collect_nodes(res, needed)
 
         return jax.jit(step)
 
-    def _make_sp_eval_step(self):
-        """Sequence-parallel inference: shard_map over ('data','seq'),
-        ring attention inside; top node only (guarded at init)."""
+    def _make_sp_eval_step(self, extract: Tuple[str, ...] = ()):
+        """Sequence-parallel inference: partial-manual shard_map over
+        ('data','seq') ('model' stays automatic for tp/ep), ring attention
+        inside. Captures metric-bound and extracted nodes — every node of
+        an sp-safe graph is (b, s, 1, n) with the sequence on axis 1, so
+        one out-spec covers them all."""
         from jax.sharding import PartitionSpec as P
         net = self.net
         seq_axis, data_axis = self.mesh.seq_axis, self.mesh.data_axis
+        needed = sorted(set(self._needed_nodes()) | set(extract))
+        capture = bool(needed)
 
         def step(params, net_state, data):
             res = net.apply(params, net_state, data, train=False,
-                            seq_axis=seq_axis)
-            return res.out
+                            seq_axis=seq_axis, data_axis=data_axis,
+                            capture_nodes=capture)
+            return _collect_nodes(res, needed)
 
+        node_spec = P(data_axis, seq_axis, None, None)
         wrapped = jax.shard_map(
             step, mesh=self.mesh.mesh,
             in_specs=(P(), P(), P(data_axis, None, None, seq_axis)),
-            out_specs=P(data_axis, seq_axis, None, None))
+            out_specs={k: node_spec for k in [_TOP] + needed},
+            axis_names={data_axis, seq_axis})
         return jax.jit(wrapped)
 
     def _eval_nodes(self, batch: DataBatch,
@@ -744,14 +768,12 @@ class Trainer:
             data = self.mesh.shard_batch(batch.data)
             return self._eval_step_fn[1](self.params, self.net_state, data)
         if self._sp > 1:
-            if extract:
-                raise ValueError(
-                    "seq_parallel supports extraction of the top node only")
-            if self._eval_step_fn is None or self._eval_step_fn[0] != "sp":
-                self._eval_step_fn = ("sp", self._make_sp_eval_step())
+            key = ("sp", tuple(extract))
+            if self._eval_step_fn is None or self._eval_step_fn[0] != key:
+                self._eval_step_fn = (key, self._make_sp_eval_step(
+                    tuple(extract)))
             data = self._shard_seq_batch(batch.data)
-            return {_TOP: self._eval_step_fn[1](self.params, self.net_state,
-                                                data)}
+            return self._eval_step_fn[1](self.params, self.net_state, data)
         key = tuple(extract)
         if self._eval_step_fn is None or self._eval_step_fn[0] != key:
             self._eval_step_fn = (key, self._make_eval_step(extract))
