@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from .base import Layer, register_layer
 from .seq import _seq, _unseq
@@ -83,7 +84,6 @@ class MoELayer(Layer):
         return {"_aux_loss": jnp.zeros((), jnp.float32)}
 
     def apply(self, params, state, inputs, ctx):
-        from jax import lax
         x = _seq(inputs[0]).astype(ctx.compute_dtype)   # (B, T, E)
         B, T, E = x.shape
         X = self.num_expert

@@ -13,10 +13,14 @@ taking (batch, seq, heads, head_dim) arrays:
   softmax recurrence (running max / normalizer); O(S * block_k) live
   memory, differentiable through the scan, works on any backend. This is
   also the backward path for the flash kernel.
-* ``flash_attention`` — Pallas kernel tiling q into MXU-friendly blocks
-  and streaming k/v blocks through VMEM (forward); custom_vjp with the
-  chunked implementation as backward. ``interpret=True`` runs the same
-  kernel on CPU for tests.
+* ``flash_attention`` — Pallas kernels tiling q into MXU-friendly blocks
+  and streaming k/v blocks through VMEM. The forward also emits the
+  per-row logsumexp; the backward is FUSED (dq and dk/dv kernels that
+  rebuild the softmax from that statistic — no second online pass, no
+  chunked recompute). ``interpret=True`` runs the same kernels on CPU
+  for tests. Not twice-differentiable (the fused backward is a kernel,
+  not traced jnp); differentiate ``chunked_attention`` for higher-order
+  uses.
 
 Masking convention: ``causal=True`` masks strictly-future positions.
 Fully-masked rows produce zeros (guarded divide), so ragged/padded
@@ -144,12 +148,26 @@ def chunked_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 # -- Pallas flash attention ---------------------------------------------------
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+def _block_causal_mask(qi, kj, block_q, block_k):
+    """Causal keep-mask for one (q-block, k-block) tile — shared by the
+    forward and both backward kernels so the masking convention cannot
+    drift between them."""
+    qpos = qi * block_q + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    kpos = kj * block_k + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return qpos >= kpos
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                      acc_ref, m_ref, l_ref, *,
                       scale, causal, block_q, block_k):
     """One (batch*head, q-block, k-block) grid cell. K/V truly stream: each
     cell sees only one (block_k, D) K/V tile in VMEM; the online-softmax
     accumulators persist in VMEM scratch across the (innermost, sequential)
     k-block grid dimension, so VMEM residency is O(block) not O(S).
+    Also emits the per-row logsumexp — the statistic the fused backward
+    kernels rebuild the softmax from without a second online pass.
     """
     qi = pl.program_id(1)
     kj = pl.program_id(2)
@@ -167,11 +185,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         vb = v_ref[0].astype(jnp.float32)
         s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            qpos = qi * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kpos = kj * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            mask = qpos >= kpos
+            mask = _block_causal_mask(qi, kj, block_q, block_k)
             s = jnp.where(mask, s, _NEG)
         m_prev = m_ref[:, 0]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
@@ -194,8 +208,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(kj == nk - 1)
     def _finish():
-        o_ref[0] = (acc_ref[...] /
-                    jnp.maximum(l_ref[:, 0], 1e-30)[:, None]).astype(o_ref.dtype)
+        l_fin = jnp.maximum(l_ref[:, 0], 1e-30)
+        o_ref[0] = (acc_ref[...] / l_fin[:, None]).astype(o_ref.dtype)
+        lse_ref[0, :, 0] = m_ref[:, 0] + jnp.log(l_fin)
 
 
 try:  # pallas import kept lazy-safe: CPU-only installs still get chunked
@@ -206,7 +221,8 @@ except Exception:  # pragma: no cover
     _HAVE_PALLAS = False
 
 
-def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
+                   with_lse: bool = False):
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
     block_q = min(block_q, Sq)
@@ -222,7 +238,7 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
     kern = functools.partial(
         _flash_fwd_kernel, scale=_scale(q, scale), causal=causal,
         block_q=block_q, block_k=block_k)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kern,
         grid=(B * H, Sq // block_q, Sk // block_k),
         in_specs=[
@@ -230,8 +246,16 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            # (BH, Sq, 1): trailing dims (block_q, 1) satisfy the TPU
+            # (8, 128)-divisible-or-full block constraint
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Sq, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),   # acc
             pltpu.VMEM((block_q, 1), jnp.float32),   # running max
@@ -239,7 +263,8 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(qt, kt, vt)
-    return out.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
+    out = out.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
+    return (out, lse) if with_lse else out
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -260,26 +285,172 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
 
 
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_acc, *, scale, causal, block_q, block_k):
+    """dQ_i = scale * sum_j dS_ij K_j, dS = P o (dP - delta); P rebuilt
+    from the saved logsumexp (no second online pass). Grid
+    (batch*head, q-block, k-block sequential)."""
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32)          # (block_q, D)
+        kb = k_ref[0].astype(jnp.float32)         # (block_k, D)
+        vb = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)        # (block_q, D)
+        lse = lse_ref[0, :, 0]                    # (block_q,)
+        delta = delta_ref[0, :, 0]                # (block_q,)
+        s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse[:, None])
+        if causal:
+            # explicit zeroing: fully-masked rows carry a sentinel lse,
+            # where exp(s - lse) would NOT vanish on its own
+            p = jnp.where(_block_causal_mask(qi, kj, block_q, block_k),
+                          p, 0.0)
+        dp = jnp.dot(do, vb.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dq_acc[...] += jnp.dot(ds, kb, preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(kj * block_k <= qi * block_q + block_q - 1)
+        def _guarded():
+            compute()
+    else:
+        compute()
+
+    @pl.when(kj == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *,
+                          scale, causal, block_q, block_k):
+    """dK_j = scale * sum_i dS_ij^T Q_i; dV_j = sum_i P_ij^T dO_i. Grid
+    (batch*head, k-block, q-block sequential)."""
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32)          # (block_q, D)
+        kb = k_ref[0].astype(jnp.float32)         # (block_k, D)
+        vb = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :, 0]
+        delta = delta_ref[0, :, 0]
+        s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse[:, None])
+        if causal:
+            p = jnp.where(_block_causal_mask(qi, kj, block_q, block_k),
+                          p, 0.0)
+        dv_acc[...] += jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, vb.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk_acc[...] += jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+
+    if causal:
+        # only q blocks at or below the diagonal contribute to this k tile
+        @pl.when(qi * block_q + block_q - 1 >= kj * block_k)
+        def _guarded():
+            compute()
+    else:
+        compute()
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
+                    interpret):
+    """Fused Pallas backward: dq from one kernel, dk/dv from another,
+    both rebuilding the softmax from the forward's logsumexp."""
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    block_q = min(block_q, Sq)
+    block_k = min(block_k, Sk)
+    sc = _scale(q, scale)
+    qt = q.transpose(0, 2, 1, 3).reshape(B * H, Sq, D)
+    kt = k.transpose(0, 2, 1, 3).reshape(B * H, Sk, D)
+    vt = v.transpose(0, 2, 1, 3).reshape(B * H, Sk, D)
+    dot = g.transpose(0, 2, 1, 3).reshape(B * H, Sq, D)
+    # delta_i = rowsum(dO_i * O_i) — cheap elementwise precompute
+    delta = jnp.sum(dot.astype(jnp.float32)
+                    * out.transpose(0, 2, 1, 3).reshape(B * H, Sq, D)
+                    .astype(jnp.float32), axis=-1)[..., None]
+
+    q_spec = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0))
+    k_spec = pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0))
+    r_spec = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, scale=sc, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(B * H, Sq // block_q, Sk // block_k),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    # swapped grid: (bh, k-block, q-block) — index maps swap i/j roles
+    q_spec2 = pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0))
+    k_spec2 = pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0))
+    r_spec2 = pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, scale=sc, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(B * H, Sk // block_k, Sq // block_q),
+        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, r_spec2, r_spec2],
+        out_specs=[k_spec2, k_spec2],
+        out_shape=[jax.ShapeDtypeStruct((B * H, Sk, D), k.dtype),
+                   jax.ShapeDtypeStruct((B * H, Sk, D), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
+                        pltpu.VMEM((block_k, D), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    unflat = lambda a, S: a.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+    return unflat(dq, Sq), unflat(dk, Sk), unflat(dv, Sk)
+
+
 def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
     if not _HAVE_PALLAS:
         out = chunked_attention(q, k, v, causal=causal, scale=scale,
                                 block_k=block_k)
-        return out, (q, k, v)
+        return out, (q, k, v, None, None)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    out = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                              interpret, with_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    # Backward = differentiate the chunked implementation (recompute);
-    # identical math, O(S * block) live memory under remat.
-    def f(q_, k_, v_):
-        return chunked_attention(q_, k_, v_, causal=causal, scale=scale,
-                                 block_k=block_k)
-    _, vjp = jax.vjp(f, q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    if not _HAVE_PALLAS:
+        # fall back to differentiating the chunked implementation
+        def f(q_, k_, v_):
+            return chunked_attention(q_, k_, v_, causal=causal, scale=scale,
+                                     block_k=block_k)
+        _, vjp = jax.vjp(f, q, k, v)
+        return vjp(g)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_backward(q, k, v, out, lse, g, causal, scale,
+                           block_q, block_k, interpret)
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)

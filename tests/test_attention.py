@@ -47,20 +47,39 @@ def test_flash_matches_reference(causal):
     np.testing.assert_allclose(out, ref, atol=2e-5)
 
 
-def test_flash_gradients_match_reference():
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gradients_match_reference(causal):
+    """The fused Pallas backward (dq + dk/dv kernels from the saved
+    logsumexp) must match autodiff of the plain reference."""
     q, k, v = _qkv(s=64)
 
     def loss_ref(q, k, v):
-        return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+        return jnp.sum(attention_reference(q, k, v, causal=causal) ** 2)
 
     def loss_flash(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, causal=True, block_q=32,
+        return jnp.sum(flash_attention(q, k, v, causal=causal, block_q=32,
                                        block_k=32, interpret=True) ** 2)
 
     g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     g_fl = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g_ref, g_fl):
         np.testing.assert_allclose(a, b, atol=5e-5)
+
+
+def test_flash_vjp_matches_chunked_vjp():
+    """Random-cotangent vjp equality against the chunked implementation,
+    with rectangular blocks (16x32) so grid accumulation order differs
+    from every other path."""
+    q, k, v = _qkv(s=96)
+    g = jnp.asarray(np.random.RandomState(9).randn(*q.shape), jnp.float32)
+
+    _, vjp_c = jax.vjp(lambda a, b, c: chunked_attention(
+        a, b, c, causal=True, block_k=32), q, k, v)
+    _, vjp_f = jax.vjp(lambda a, b, c: flash_attention(
+        a, b, c, causal=True, block_q=16, block_k=32, interpret=True),
+        q, k, v)
+    for a, b in zip(vjp_c(g), vjp_f(g)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
 
 
 def test_flash_rejects_nondivisible_seq():
