@@ -3,7 +3,8 @@
 
 Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N,
-     "model_tflops": ..., "mfu_pct": ..., "e2e_images_per_sec_per_chip": ...,
+     "model_tflops": ..., "mfu_pct": ..., "roofline_pct": ...,
+     "arith_intensity": ..., "e2e_images_per_sec_per_chip": ...,
      "loss_start": ..., "loss_end": ...}
 
 Three claims, each verified in-run:
@@ -42,21 +43,23 @@ sys.path.insert(0, os.path.join(_REPO, "examples", "ImageNet"))
 # Efficiency claims are grounded in MFU below, not in this constant.
 BASELINE_IPS = 150.0
 
-# Dense bf16 peak TFLOP/s per chip, by device_kind substring. First match
-# in list order wins — keep more specific keys (v5p, v5 lite) before their
-# prefixes (v5). Sources: public TPU spec sheets.
-_PEAK_BF16_TFLOPS = [
-    ("v6", 918.0), ("v5p", 459.0), ("v5 lite", 197.0), ("v5e", 197.0),
-    ("v5", 459.0), ("v4", 275.0), ("v3", 123.0), ("v2", 45.0),
+# (dense bf16 peak TFLOP/s, HBM GB/s) per chip, by device_kind substring.
+# First match in list order wins — keep more specific keys (v5p, v5 lite)
+# before their prefixes (v5). Sources: public TPU spec sheets.
+_CHIP_PEAKS = [
+    ("v6", (918.0, 1638.0)), ("v5p", (459.0, 2765.0)),
+    ("v5 lite", (197.0, 819.0)), ("v5e", (197.0, 819.0)),
+    ("v5", (459.0, 2765.0)), ("v4", (275.0, 1228.0)),
+    ("v3", (123.0, 900.0)), ("v2", (45.0, 700.0)),
 ]
 
 
-def chip_peak_tflops(device) -> float:
+def chip_peaks(device):
     kind = getattr(device, "device_kind", "").lower()
-    for key, peak in _PEAK_BF16_TFLOPS:
+    for key, peaks in _CHIP_PEAKS:
         if key in kind:
-            return peak
-    return 0.0   # unknown (e.g. CPU smoke run) -> mfu reported as 0
+            return peaks
+    return 0.0, 0.0   # unknown (e.g. CPU smoke run) -> mfu reported as 0
 
 
 def make_trainer(scale, image, classes, batch, platform):
@@ -109,13 +112,25 @@ def compute_bench(tr, image, classes, batch, steps):
     # compiled cost_analysis reports the per-device (SPMD-partitioned)
     # module's FLOPs, so this is already per-chip — no n_chips division
     sustained_tflops = cost["flops"] * steps / dt / 1e12
-    peak = chip_peak_tflops(jax.devices()[0])
+    peak, hbm_gbs = chip_peaks(jax.devices()[0])
+    # roofline: with arithmetic intensity AI = flops/byte, the achievable
+    # rate is min(MXU peak, AI * HBM bandwidth). Inception-BN at batch 256
+    # is HBM-bound (AI ~ 64 flop/byte on v5e), so roofline_pct — not raw
+    # MFU — is the analog of the reference's "GPU utilization normally
+    # above 95%" health bar (/root/reference/doc/debug_perf.md:3-5).
+    have_bytes = cost["bytes_accessed"] > 0
+    ai = cost["flops"] / cost["bytes_accessed"] if have_bytes else 0.0
+    achievable = min(peak, ai * hbm_gbs / 1e3) if peak and have_bytes else 0.0
     return {
         "ips": ips,
         "step_tflop": cost["flops"] / 1e12,
         "model_tflops": sustained_tflops,
         "mfu_pct": 100.0 * sustained_tflops / peak if peak else 0.0,
+        "roofline_pct": (100.0 * sustained_tflops / achievable
+                         if achievable else 0.0),
+        "arith_intensity": ai,
         "peak_bf16_tflops": peak,
+        "hbm_gbs": hbm_gbs,
         "loss_start": loss_start,
         "loss_end": loss_end,
         "n_chips": n_chips,
@@ -217,6 +232,8 @@ def main() -> None:
         "vs_baseline": round(c["ips"] / BASELINE_IPS, 3),
         "model_tflops": round(c["model_tflops"], 2),
         "mfu_pct": round(c["mfu_pct"], 2),
+        "roofline_pct": round(c["roofline_pct"], 2),
+        "arith_intensity": round(c["arith_intensity"], 1),
         "step_tflop": round(c["step_tflop"], 4),
         "peak_bf16_tflops": c["peak_bf16_tflops"],
         "chip": jax.devices()[0].device_kind,

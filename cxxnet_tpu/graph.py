@@ -42,6 +42,10 @@ KNOWN_LAYER_TYPES = {
     # long-context is N/A there — first-class here)
     "embed", "layernorm", "mha", "ffn", "seqfc", "add", "lmloss", "moe",
     "posembed",
+    # user-plugin layers (the reference's Caffe-adapter plugin spirit,
+    # src/plugin/caffe_adapter-inl.hpp: embed foreign layer code in the
+    # graph — here a user Python/JAX Layer subclass)
+    "plugin",
 }
 
 

@@ -70,12 +70,51 @@ class PairTestLayer(Layer):
         return out_m, {"master": st_m, "slave": st_s, "diff": diff}
 
 
+def _create_plugin_layer(spec: LayerSpec, global_cfg: ConfigPairs) -> Layer:
+    """User-plugin layer — the TPU-native analog of the reference's Caffe
+    adapter plugin (src/plugin/caffe_adapter-inl.hpp: embed a foreign layer
+    implementation in the config graph). Here the foreign implementation
+    is a user Python module defining a Layer subclass (pure JAX, so it
+    jits/shards like any built-in):
+
+        layer[+1] = plugin:mine
+          plugin_module = my_layers      # importable module
+          plugin_layer = MyLayer         # Layer subclass in that module
+
+    Every other param in the block reaches the class's set_param as usual.
+    """
+    import importlib
+    mod_name = cls_name = None
+    for k, v in spec.cfg:
+        if k == "plugin_module":
+            mod_name = v
+        elif k == "plugin_layer":
+            cls_name = v
+    if not mod_name or not cls_name:
+        raise ValueError(
+            "plugin layer needs both plugin_module and plugin_layer")
+    try:
+        mod = importlib.import_module(mod_name)
+    except ImportError as e:
+        raise ValueError(
+            f"plugin layer: cannot import module {mod_name!r} "
+            "(is it on PYTHONPATH?)") from e
+    cls = getattr(mod, cls_name, None)
+    if cls is None or not (isinstance(cls, type) and issubclass(cls, Layer)):
+        raise ValueError(
+            f"plugin layer: {mod_name}.{cls_name} is not a "
+            "cxxnet_tpu.layers.Layer subclass")
+    return cls(spec, global_cfg)
+
+
 def create_layer(spec: LayerSpec, global_cfg: ConfigPairs) -> Layer:
     """Factory (reference layer_impl-inl.hpp:36-81). ``share`` specs are
     resolved by the model builder (the primary layer object is reused), so
     they never reach this factory."""
     if spec.type == "pairtest":
         return PairTestLayer(spec, global_cfg)
+    if spec.type == "plugin":
+        return _create_plugin_layer(spec, global_cfg)
     if spec.type not in LAYER_REGISTRY:
         raise ValueError(f"unknown layer type: {spec.type!r}")
     return LAYER_REGISTRY[spec.type](spec, global_cfg)

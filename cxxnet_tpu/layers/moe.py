@@ -12,7 +12,9 @@ the expert all-to-all over ICI.
 Config (sequence node (E,S,1) -> (E,S,1)):
   ``num_expert``, ``topk`` (1 or 2), ``nhidden`` (expert inner dim),
   ``capacity_factor`` (default 1.25), ``act`` (gelu/relu),
-  ``moe_loss_coef`` (load-balance aux loss weight, default 0.01).
+  ``moe_loss_coef`` (load-balance aux loss weight, default 0.01),
+  ``no_drop`` (1 = dense all-expert evaluation, no token ever dropped —
+  X/topk more expert FLOPs; for eval/correctness baselines).
 
 The load-balancing auxiliary loss (mean fraction-routed * mean gate prob
 per expert, scaled by num_expert) rides the layer state under
@@ -47,6 +49,8 @@ class MoELayer(Layer):
             self.act = val
         elif name == "moe_loss_coef":
             self.moe_loss_coef = float(val)
+        elif name == "no_drop":
+            self.no_drop = int(val)
 
     def __init__(self, spec, global_cfg):
         self.num_expert = 8
@@ -54,6 +58,7 @@ class MoELayer(Layer):
         self.capacity_factor = 1.25
         self.act = "gelu"
         self.moe_loss_coef = 0.01
+        self.no_drop = 0
         super().__init__(spec, global_cfg)
         if self.topk not in (1, 2):
             raise ValueError("moe: topk must be 1 or 2")
@@ -113,6 +118,35 @@ class MoELayer(Layer):
             sel.append((oh2, jnp.take_along_axis(gates, idx2[..., None],
                                                  axis=-1)[..., 0]))
 
+        # load-balance aux loss (GShard eq.4), shared by both dataflow
+        # modes: X * mean_x(frac_tokens_x * mean_gate_x), with GLOBAL
+        # means over any manual shard axes
+        frac = jnp.mean(oh1, axis=(0, 1))
+        mean_gate = jnp.mean(gates, axis=(0, 1))
+        for ax in (sp_ax, ctx.data_axis):
+            if ax is not None:
+                frac = lax.pmean(frac, ax)
+                mean_gate = lax.pmean(mean_gate, ax)
+        aux = self.moe_loss_coef * X * jnp.sum(frac * mean_gate)
+
+        if self.no_drop:
+            # no-drop mode: dense evaluation — every expert runs on every
+            # token and the top-k gate mask selects outputs, so NO token is
+            # ever dropped regardless of load imbalance. Costs X/topk more
+            # expert FLOPs than the capacity path; use for eval,
+            # correctness baselines, or small expert counts.
+            w = sum(oh * gate[..., None] for oh, gate in sel)   # (B,T,X)
+            h = jnp.einsum("bte,xef->btxf", x,
+                           params["h"]["wmat"].astype(ctx.compute_dtype))
+            h = h + params["h"]["bias"].astype(ctx.compute_dtype)[None, None]
+            h = jax.nn.gelu(h) if self.act == "gelu" else jax.nn.relu(h)
+            y = jnp.einsum("btxf,xfe->btxe", h,
+                           params["o"]["wmat"].astype(ctx.compute_dtype))
+            y = y + params["o"]["bias"].astype(ctx.compute_dtype)[None, None]
+            out = jnp.einsum("btx,btxe->bte", w.astype(jnp.float32),
+                             y.astype(jnp.float32)).astype(ctx.compute_dtype)
+            return [_unseq(out)], {"_aux_loss": aux}
+
         # position-in-expert via cumulative sum over tokens; tokens past the
         # capacity C are dropped (standard Switch behavior, keeps shapes
         # static for XLA). prev_count carries the GLOBAL per-expert fill
@@ -163,15 +197,4 @@ class MoELayer(Layer):
         out = jnp.einsum("btxc,bxce->bte", combine,
                          y.astype(jnp.float32)).astype(ctx.compute_dtype)
 
-        # load-balance aux loss (GShard eq.4): X * mean_x(frac_tokens_x *
-        # mean_gate_x) — means over the GLOBAL token population under
-        # shard_map (both the seq AND the manual batch axis: the product
-        # of global means, not a mean of per-shard products)
-        frac = jnp.mean(oh1, axis=(0, 1))                # (X,)
-        mean_gate = jnp.mean(gates, axis=(0, 1))
-        for ax in (sp_ax, ctx.data_axis):
-            if ax is not None:
-                frac = lax.pmean(frac, ax)
-                mean_gate = lax.pmean(mean_gate, ax)
-        aux = self.moe_loss_coef * X * jnp.sum(frac * mean_gate)
         return [_unseq(out)], {"_aux_loss": aux}

@@ -50,6 +50,43 @@ seed_data = 11
 """
 
 
+def test_moe_no_drop_matches_undropped_capacity(mesh8):
+    """no_drop=1 (dense all-expert evaluation) must agree with the
+    capacity path when capacity is large enough that nothing drops —
+    same math, different dataflow."""
+    from cxxnet_tpu.layers.base import ApplyCtx
+    from cxxnet_tpu.layers import create_layer
+    from cxxnet_tpu.graph import build_graph
+    cfg_t = """
+netconfig=start
+layer[+1:f1] = moe:m
+  num_expert = 4
+  topk = 2
+  nhidden = 32
+  capacity_factor = {cf}
+  {extra}
+netconfig=end
+input_shape = 16,8,1
+batch_size = 4
+"""
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(4, 8, 1, 16), jnp.float32)
+    outs = {}
+    for name, cf, extra in (("cap", "100.0", ""),
+                            ("nodrop", "0.1", "no_drop = 1")):
+        cfg = parse_config_string(cfg_t.format(cf=cf, extra=extra))
+        g = build_graph(cfg)
+        layer = create_layer(g.layers[0], g.defcfg)
+        layer.infer_shapes([(16, 8, 1)])
+        params = layer.init_params(jax.random.PRNGKey(0), [(16, 8, 1)])
+        ctx = ApplyCtx(train=True)
+        (out,), st = layer.apply(params, {}, [x], ctx)
+        outs[name] = (np.asarray(out), float(st["_aux_loss"]))
+    np.testing.assert_allclose(outs["cap"][0], outs["nodrop"][0],
+                               rtol=1e-4, atol=1e-5)
+    assert abs(outs["cap"][1] - outs["nodrop"][1]) < 1e-6
+
+
 def _pp_mesh(pp, dp):
     devs = jax.devices()[:pp * dp]
     return make_mesh_context(devices=devs, pipeline_parallel=pp)
