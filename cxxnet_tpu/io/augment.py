@@ -49,6 +49,7 @@ class AugmentParams:
         self.mean_value: Optional[np.ndarray] = None    # (3,) RGB
         self.mean_img: str = ""
         self.divideby = 1.0
+        self.device_normalize = 0
         self.scale = 1.0
 
     def set_param(self, name: str, val: str) -> bool:
@@ -97,6 +98,8 @@ class AugmentParams:
                 [float(x) for x in val.split(",")], np.float32)
         elif name == "divideby":
             self.divideby = float(val)
+        elif name == "device_normalize":
+            self.device_normalize = int(val)
         elif name == "scale":
             self.scale = float(val)
         else:

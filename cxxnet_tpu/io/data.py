@@ -25,6 +25,10 @@ class DataBatch:
     num_batch_padd: int = 0               # trailing rows that are padding
     inst_index: Optional[np.ndarray] = None  # (batch,) instance ids
     extra_data: List[np.ndarray] = dataclasses.field(default_factory=list)
+    # device_normalize=1 pipelines: data is uint8 and this carries the
+    # deferred normalization {"mean": (3,)|(y,x,c)|None, "divideby": f}
+    # for the trainer to apply on-device after the (4x smaller) H2D copy
+    norm: Optional[dict] = None
 
     @property
     def batch_size(self) -> int:

@@ -266,7 +266,14 @@ class ImageRecordIterator(DataIter):
         rec = ImageRecord.unpack(payload)
         rng = np.random.RandomState(self._hash_seed(item_counter))
         img = self.augmenter.process(self._decode(rec), rng)
-        img = self.mean.apply(img, self.aug)
+        if self.aug.device_normalize:
+            # defer mean/divideby/scale to the device (trainer applies them
+            # after a 4x smaller uint8 host->device copy); crop/mirror
+            # augmentation keeps exact uint8 pixels, float-producing
+            # augmentations (affine/contrast) round to the nearest LSB
+            img = np.clip(np.rint(img), 0.0, 255.0).astype(np.uint8)
+        else:
+            img = self.mean.apply(img, self.aug)
         if self._label_map is not None and rec.inst_id in self._label_map:
             lab = self._label_map[rec.inst_id]
         else:
@@ -330,5 +337,14 @@ class ImageRecordIterator(DataIter):
         data = np.stack([t[0] for t in take])
         label = np.stack([t[1] for t in take])
         index = np.asarray([t[2] for t in take], np.int64)
+        norm = None
+        if self.aug.device_normalize:
+            # same precedence and op order as the host path
+            # (MeanStore.apply): mean_value wins over the mean image, then
+            # divideby, then scale
+            mean = (self.aug.mean_value if self.aug.mean_value is not None
+                    else (self.mean.mean if self.mean.ready else None))
+            norm = {"mean": mean, "divideby": self.aug.divideby,
+                    "scale": self.aug.scale}
         return DataBatch(data=data, label=label, num_batch_padd=padd,
-                         inst_index=index)
+                         inst_index=index, norm=norm)

@@ -145,6 +145,56 @@ def test_imgrec_round_batch(rec_file):
     assert sorted(all_real) == list(range(20))
 
 
+def test_device_normalize_matches_host_path(rec_file, mesh8):
+    """device_normalize=1 ships uint8 batches (4x smaller H2D) and defers
+    mean/divideby to the device; with crop/mirror-only augmentation the
+    pixels are exact uint8, so the normalized device arrays must equal the
+    host-normalized float pipeline bit-for-bit."""
+    from cxxnet_tpu.trainer import Trainer
+    from cxxnet_tpu.config import parse_config_string
+
+    def batches(device_norm):
+        cfg = [
+            ("iter", "imgrec"),
+            ("image_rec", rec_file),
+            ("input_shape", "3,32,32"),
+            ("batch_size", "8"),
+            ("rand_crop", "1"),
+            ("rand_mirror", "1"),
+            ("seed_data", "5"),
+            ("mean_value", "100,110,120"),
+            ("divideby", "64"),
+            ("scale", "0.5"),
+            ("device_normalize", str(device_norm)),
+            ("iter", "end"),
+        ]
+        return list(create_iterator(cfg))
+
+    host = batches(0)
+    dev = batches(1)
+    assert dev[0].data.dtype == np.uint8 and dev[0].norm is not None
+    tr = Trainer(parse_config_string("""
+netconfig=start
+layer[+1] = flatten
+layer[+1] = fullc:fc
+  nhidden = 4
+layer[+0] = softmax
+netconfig=end
+input_shape = 3,32,32
+batch_size = 8
+eval_train = 0
+"""), mesh_ctx=mesh8)
+    for hb, db in zip(host, dev):
+        normed = tr._device_normalize(tr.mesh.shard_batch(db.data), db)
+        np.testing.assert_allclose(np.asarray(normed), hb.data,
+                                   rtol=1e-6, atol=1e-6)
+    # and the trainer trains on the uint8 batches end-to-end
+    tr.init_model()
+    for b in dev:
+        tr.update(b)
+    assert np.isfinite(tr.last_loss)
+
+
 def test_imgrec_mean_and_labels(rec_file, tmp_path):
     mean_path = str(tmp_path / "mean.bin")
     cfg = [

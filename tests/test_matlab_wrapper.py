@@ -1,0 +1,65 @@
+"""MATLAB wrapper consistency checks.
+
+The .m files (wrapper/matlab/) drive the same C ABI the C demo exercises
+(reference wrapper/matlab/cxxnet_mex.cpp compiled a MEX dispatch; here
+loadlibrary/calllib needs no compilation step). No MATLAB/Octave exists in
+this build environment, so what CAN be checked automatically is checked:
+every `calllib` target must be a real exported symbol of libcxxnet_capi.so
+and declared in the header the .m files load against — the failure mode
+these tests close is the wrapper silently going stale when capi.cc
+changes. Running under real MATLAB is documented in wrapper/matlab/
+(cxxnet_load.m + the header are the only requirements).
+"""
+
+import ctypes
+import os
+import re
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from conftest import NATIVE_DIR, build_native
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MATLAB_DIR = os.path.join(REPO, "wrapper", "matlab")
+_LIB = os.path.join(NATIVE_DIR, "libcxxnet_capi.so")
+
+_CALL_RE = re.compile(r"calllib\(\s*'cxxnet_capi'\s*,\s*'([A-Za-z0-9_]+)'")
+
+
+def _calllib_targets():
+    names = set()
+    for fn in os.listdir(MATLAB_DIR):
+        if fn.endswith(".m"):
+            with open(os.path.join(MATLAB_DIR, fn)) as f:
+                names.update(_CALL_RE.findall(f.read()))
+    return names
+
+
+def test_m_files_reference_real_symbols():
+    """Every calllib('cxxnet_capi', 'X') in the .m files must exist as an
+    exported symbol in the built shared library."""
+    import subprocess
+    ok, stderr = build_native("libcxxnet_capi.so", "capi.cc")
+    if not ok:
+        pytest.skip(f"capi build unavailable: {stderr[-200:]}")
+    names = _calllib_targets()
+    assert names, "no calllib targets found in wrapper/matlab/*.m"
+    lib = ctypes.CDLL(_LIB)
+    missing = [n for n in names if not hasattr(lib, n)]
+    assert not missing, (
+        f"MATLAB wrapper calls symbols missing from libcxxnet_capi.so: "
+        f"{sorted(missing)} — the .m files have drifted from capi.cc")
+
+
+def test_m_files_match_header():
+    """The same calllib targets must be declared in cxxnet_capi.h (the
+    prototype file loadlibrary parses)."""
+    with open(os.path.join(MATLAB_DIR, "cxxnet_capi.h")) as f:
+        header = f.read()
+    undeclared = [n for n in _calllib_targets() if n not in header]
+    assert not undeclared, (
+        f"calllib targets not declared in cxxnet_capi.h: "
+        f"{sorted(undeclared)}")
