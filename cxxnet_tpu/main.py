@@ -52,6 +52,17 @@ def split_sections(cfg: ConfigPairs):
     return global_cfg, sections
 
 
+def _text_out(path: str):
+    """Text output stream for pred/extract/get_weight results — local or
+    remote (gs:// etc) through the io.stream seam."""
+    import io as _io
+    from .io import stream
+    if stream.is_remote(path):
+        raw = stream.sopen(path, "wb")
+        return _io.TextIOWrapper(raw, encoding="utf-8")
+    return open(path, "w")
+
+
 class LearnTask:
     def __init__(self, cfg: ConfigPairs):
         self.cfg = cfg
@@ -248,7 +259,7 @@ class LearnTask:
         itr = self.pred_iter() or self.train_iter()
         if itr is None:
             raise ValueError("no pred/data section in config")
-        with open(self.name_pred, "w") as f:
+        with _text_out(self.name_pred) as f:
             for batch in itr:
                 for v in tr.predict(batch):
                     f.write(f"{float(v):g}\n")
@@ -261,7 +272,7 @@ class LearnTask:
         itr = self.pred_iter() or self.train_iter()
         if itr is None:
             raise ValueError("no pred/data section in config")
-        with open(self.name_pred, "w") as f:
+        with _text_out(self.name_pred) as f:
             for batch in itr:
                 feats = tr.extract_feature(batch, self.extract_node_name)
                 for row in feats:
@@ -277,7 +288,7 @@ class LearnTask:
         if not layer:
             raise ValueError("get_weight requires weight_layer=<name>")
         w = tr.get_weight(layer, tag)
-        with open(self.name_pred, "w") as f:
+        with _text_out(self.name_pred) as f:
             f.write(" ".join(str(d) for d in w.shape) + "\n")
             for row in w.reshape(w.shape[0], -1):
                 f.write(" ".join(f"{float(v):g}" for v in row) + "\n")

@@ -87,6 +87,15 @@ def test_config_file_remote():
     assert ("eta", "0.1") in cfg and ("batch_size", "32") in cfg
 
 
+def test_text_output_remote():
+    """task=pred/extract/get_weight text outputs route through the seam."""
+    from cxxnet_tpu.main import _text_out
+    with _text_out("memory://out/pred.txt") as f:
+        f.write("3\n7\n")
+    with stream.sopen("memory://out/pred.txt", "rb") as f:
+        assert f.read() == b"3\n7\n"
+
+
 def test_write_bytes_atomic_local(tmp_path):
     p = str(tmp_path / "x.bin")
     stream.write_bytes_atomic(p, b"hello")
