@@ -74,8 +74,30 @@ def make_trainer(scale, image, classes, batch, platform):
     return tr
 
 
-def compute_bench(tr, image, classes, batch, steps):
-    """Device-resident compute-path timing + cost analysis + loss check."""
+def single_chip_cost(scale, image, classes, batch_per_chip, platform):
+    """Per-chip cost truth for multi-chip runs: lower the SAME train step
+    on one device at the per-chip batch and read its compiled cost
+    analysis — deterministic, unlike inferring whether a multi-chip
+    cost_analysis() reported per-device or whole-module numbers."""
+    import numpy as np
+    from cxxnet_tpu.io.data import DataBatch
+    tr = make_trainer(scale, image, classes, batch_per_chip,
+                      f"{platform}:0-0")
+    tr.init_model()
+    rng = np.random.RandomState(0)
+    b = DataBatch(
+        data=rng.rand(batch_per_chip, image, image, 3).astype(np.float32),
+        label=rng.randint(0, classes,
+                          size=(batch_per_chip, 1)).astype(np.float32))
+    b.data = tr.mesh.shard_batch(b.data)
+    b.label = tr.mesh.shard_batch(b.label)
+    return tr.step_cost_analysis(b)
+
+
+def compute_bench(tr, image, classes, batch, steps, ref_cost_fn=None):
+    """Device-resident compute-path timing + cost analysis + loss check.
+    ``ref_cost_fn`` (multi-chip runs): returns the single-chip cost dict
+    used as per-chip truth for the MFU/roofline math."""
     import jax
     import numpy as np
     from cxxnet_tpu.io.data import DataBatch
@@ -110,9 +132,39 @@ def compute_bench(tr, image, classes, batch, steps):
     n_chips = max(1, tr.mesh.num_devices)
     ips = steps * batch / dt / n_chips
     # compiled cost_analysis reports the per-device (SPMD-partitioned)
-    # module's FLOPs, so this is already per-chip — no n_chips division
-    sustained_tflops = cost["flops"] * steps / dt / 1e12
+    # module's FLOPs on the validated single-chip setup; some XLA versions
+    # report whole-module FLOPs on a multi-chip mesh, which would inflate
+    # mfu/roofline by n_chips. Guard: per-chip sustained throughput above
+    # the chip's physical bf16 peak is impossible — treat that as a
+    # whole-module report and divide by n_chips (flagged in the output).
     peak, hbm_gbs = chip_peaks(jax.devices()[0])
+    flops = cost["flops"]
+    flops_normalized = False
+    if n_chips > 1:
+        ref = None
+        if ref_cost_fn is not None:
+            try:
+                ref = ref_cost_fn()
+            except Exception as e:         # fall through to the peak clip
+                print(f"single-chip cost probe failed: {e}",
+                      file=sys.stderr)
+        if ref is not None and ref.get("flops"):
+            # whole-module reports show up as ~n_chips x the 1-chip truth;
+            # either way the 1-chip numbers ARE the per-chip cost
+            flops_normalized = cost["flops"] > 1.5 * ref["flops"]
+            cost = dict(cost, flops=ref["flops"],
+                        bytes_accessed=ref["bytes_accessed"])
+            flops = cost["flops"]
+    sustained_tflops = flops * steps / dt / 1e12
+    if n_chips > 1 and peak and sustained_tflops > 1.05 * peak:
+        # last-resort heuristic when the 1-chip probe was unavailable:
+        # per-chip sustained above physical peak must be a whole-module
+        # report (bytes from the same report: divide both)
+        flops = flops / n_chips
+        sustained_tflops = flops * steps / dt / 1e12
+        flops_normalized = True
+        cost = dict(cost, bytes_accessed=cost["bytes_accessed"] / n_chips)
+    cost = dict(cost, flops=flops)
     # roofline: with arithmetic intensity AI = flops/byte, the achievable
     # rate is min(MXU peak, AI * HBM bandwidth). Inception-BN at batch 256
     # is HBM-bound (AI ~ 64 flop/byte on v5e), so roofline_pct — not raw
@@ -134,6 +186,7 @@ def compute_bench(tr, image, classes, batch, steps):
         "loss_start": loss_start,
         "loss_end": loss_end,
         "n_chips": n_chips,
+        "flops_normalized": flops_normalized,
     }
 
 
@@ -196,12 +249,14 @@ def e2e_bench(tr, image, classes, batch, steps, device_normalize=0):
         ]
         it = create_iterator(cfg)
         # warm epoch: page cache + decode pool + step compile all hot
-        for b in it:
+        for b in tr.prefetch_device(it):
             tr.update(b)
         jax.block_until_ready(tr.params)
         t0 = time.perf_counter()
         count = 0
-        for b in it:
+        # device-side double buffering: batch N+1's H2D + normalize are
+        # staged while step N computes
+        for b in tr.prefetch_device(it):
             tr.update(b)
             count += b.batch_size - b.num_batch_padd
         jax.block_until_ready(tr.params)
@@ -225,7 +280,12 @@ def main() -> None:
         e2e_steps = 2
 
     tr = make_trainer(scale, image, classes, batch, platform)
-    c = compute_bench(tr, image, classes, batch, steps)
+    n_dev = len(jax.devices())
+    ref_fn = None
+    if n_dev > 1 and batch % n_dev == 0:
+        ref_fn = lambda: single_chip_cost(scale, image, classes,
+                                          batch // n_dev, platform)
+    c = compute_bench(tr, image, classes, batch, steps, ref_cost_fn=ref_fn)
     e2e_ips = e2e_bench(tr, image, classes, batch, e2e_steps)
     e2e_u8 = e2e_bench(tr, image, classes, batch, e2e_steps,
                        device_normalize=1)

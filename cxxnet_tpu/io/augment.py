@@ -49,7 +49,10 @@ class AugmentParams:
         self.mean_value: Optional[np.ndarray] = None    # (3,) RGB
         self.mean_img: str = ""
         self.divideby = 1.0
-        self.device_normalize = 0
+        # -1 = auto (imgrec resolves to 1 when the augmentation chain is
+        # uint8-exact — crop/mirror only — and records hold encoded images;
+        # see ImageRecordIterator.init). 0/1 are explicit off/on.
+        self.device_normalize = -1
         self.scale = 1.0
 
     def set_param(self, name: str, val: str) -> bool:

@@ -29,6 +29,10 @@ class DataBatch:
     # deferred normalization {"mean": (3,)|(y,x,c)|None, "divideby": f}
     # for the trainer to apply on-device after the (4x smaller) H2D copy
     norm: Optional[dict] = None
+    # batches staged on-device (Trainer.stage_batch) keep the host label
+    # here: metrics index labels host-side, and in multi-host runs the
+    # staged device label spans non-addressable shards
+    host_label: Optional[np.ndarray] = None
 
     @property
     def batch_size(self) -> int:

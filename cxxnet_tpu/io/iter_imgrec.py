@@ -65,17 +65,29 @@ def expand_conf_files(prefix: str, ids: str, rank: int, nworker: int):
     n = ub + 1 - lb
     if n <= 0:
         raise ValueError(f"image_conf_ids: empty range {ids!r}")
+    # validate the formatting over the FULL id range before worker slicing
+    # (a per-worker check could see one name and miss that every worker
+    # resolves to the same file)
+    try:
+        all_names = [prefix % i for i in range(lb, ub + 1)]
+    except (TypeError, ValueError) as e:
+        raise ValueError(
+            f"image_conf_prefix must contain a printf-style integer "
+            f"placeholder (e.g. 'part%03d'), got {prefix!r}: {e}") from e
+    if n > 1 and len(set(all_names)) != len(all_names):
+        raise ValueError(
+            f"image_conf_prefix {prefix!r} does not vary with "
+            "image_conf_ids — missing a %d placeholder?")
     if nworker > 1:
         step = (n + nworker - 1) // nworker
-        begin = min(rank * step, n) + lb
-        end = min((rank + 1) * step, n) + lb
+        begin = min(rank * step, n)
+        end = min((rank + 1) * step, n)
         if begin >= end:
             raise ValueError(
                 "image_conf: too many workers — the id list cannot be "
                 "divided between them")
-        lb, ub = begin, end - 1
-    return [((prefix % i) + ".bin", (prefix % i) + ".lst")
-            for i in range(lb, ub + 1)]
+        all_names = all_names[begin:end]
+    return [(name + ".bin", name + ".lst") for name in all_names]
 
 
 @register_iter("imgrec", "imgbin", "imgbinx", "imginst", "imgbinold")
@@ -154,6 +166,8 @@ class ImageRecordIterator(DataIter):
                 self._check_conf_batch_counts()
         elif not self.rec_path and not self.bin_path:
             raise ValueError("imgrec: image_rec (or image_bin) must be set")
+        elif self.round_batch and self.nworker > 1:
+            self._check_shard_batch_counts()
         if self.bin_path and not self.list_path:
             raise ValueError("imgbin: image_list must accompany image_bin "
                              "(labels live in the list)")
@@ -168,6 +182,30 @@ class ImageRecordIterator(DataIter):
             self._list_entries = read_image_list(self.list_path)   # once
             self._label_map = {idx: lab for idx, lab, _
                                in self._list_entries}
+        if self.aug.device_normalize == -1:
+            # auto-resolve: uint8 H2D (4x smaller transfer + on-device
+            # normalize) is the production default whenever it is exact —
+            # crop/mirror keep uint8 pixels. Fall back to the host float
+            # path for float-producing augmentations (affine/contrast/
+            # illumination), raw float-tensor records (flag==1), and
+            # images smaller than the crop (the upscale interpolates).
+            # The size check decodes only the first record; datasets that
+            # MIX sub-crop-size images behind a large first one should set
+            # device_normalize=0 explicitly.
+            exact = (not self.aug.needs_affine
+                     and self.aug.max_random_contrast == 0
+                     and self.aug.max_random_illumination == 0)
+            if exact:
+                rec = self._peek_record()
+                if rec is not None:
+                    if rec.flag != 0:
+                        exact = False
+                    else:
+                        img = self._decode(rec)
+                        _, y, x = self.input_shape
+                        if img.shape[0] < y or img.shape[1] < x:
+                            exact = False
+            self.aug.device_normalize = int(exact)
         self._pool = futures.ThreadPoolExecutor(self.nthread)
         self._rng = np.random.RandomState(self.seed + 7 * self.rank)
         # monotonically increasing per-item augmentation counter, hashed
@@ -198,6 +236,45 @@ class ImageRecordIterator(DataIter):
                 "every worker the same epoch length with these pack sizes; "
                 "re-pack into equal-size parts (tools/im2bin.py) or use a "
                 "single recordio file (byte-range sharded)")
+
+    def _peek_record(self) -> Optional[ImageRecord]:
+        """First record of this worker's shard (None for an empty shard) —
+        init-time probe for the device_normalize auto-resolution."""
+        reader = self._reader()
+        try:
+            for payload in reader:
+                return ImageRecord.unpack(payload)
+        finally:
+            close = getattr(reader, "close", None)
+            if close is not None:
+                close()
+        return None
+
+    def _check_shard_batch_counts(self) -> None:
+        """round_batch promises every rank the same number of batches per
+        epoch (each rank emits ceil(shard/batch), wrapping its own shard) —
+        but byte-range recordio shards and round-robin binpage page shards
+        can hold unequal record counts, and if the per-rank ceil counts
+        differ every rank's jitted update deadlocks waiting on a missing
+        peer. Fail fast at init with a header-only count (payload bytes are
+        never read)."""
+        if self.bin_path:
+            from .binpage import num_pages, page_object_count
+            per_page = [page_object_count(self.bin_path, p)
+                        for p in range(num_pages(self.bin_path))]
+            recs = [sum(per_page[r::self.nworker])
+                    for r in range(self.nworker)]
+        else:
+            from .recordio import shard_record_counts
+            recs = shard_record_counts(self.rec_path, self.nworker)
+        counts = [-(-n // self.batch_size) for n in recs]      # ceil
+        if len(set(counts)) != 1:
+            raise ValueError(
+                f"round_batch with {self.nworker} workers: per-rank batch "
+                f"counts {counts} (record counts {recs}) are unequal — "
+                "every rank must emit the same epoch length or distributed "
+                "training deadlocks; re-pack with tools/im2rec.py "
+                "(uniform record sizes shard evenly) or adjust batch_size")
 
     def _reader(self):
         """Iterable of packed ImageRecord payloads: recordio, a legacy

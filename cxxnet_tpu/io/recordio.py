@@ -38,11 +38,29 @@ def _pad8(n: int) -> int:
 class RecordWriter:
     def __init__(self, path: str):
         self._f: BinaryIO = sopen(path, "wb")
+        self.offsets: List[int] = []     # record start offsets, in order
+        self._pos = 0
 
     def write(self, payload: bytes) -> None:
+        self.offsets.append(self._pos)
         self._f.write(_HDR.pack(MAGIC, len(payload)))
         self._f.write(payload)
         self._f.write(b"\x00" * _pad8(len(payload)))
+        self._pos += _HDR.size + len(payload) + _pad8(len(payload))
+
+    def write_index(self, path: Optional[str] = None) -> str:
+        """Write the record-offset index (default ``<rec>.idx``, one
+        decimal offset per line — the analog of dmlc recordio's .idx).
+        ``shard_record_counts`` uses it to answer distributed epoch-length
+        checks without scanning the data file."""
+        path = path or getattr(self._f, "name", None)
+        if path is None:
+            raise ValueError("write_index: pass a path (stream is unnamed)")
+        idx_path = path if path.endswith(".idx") else path + ".idx"
+        with sopen(idx_path, "wb") as f:
+            f.write("\n".join(str(o) for o in self.offsets).encode()
+                    + b"\n")
+        return idx_path
 
     def close(self) -> None:
         self._f.close()
@@ -122,6 +140,59 @@ class RecordReader:
 
     def close(self) -> None:
         self._f.close()
+
+
+def shard_record_counts(path: str, nsplit: int) -> List[int]:
+    """Per-shard record counts for the (part, nsplit) byte-range sharding of
+    ``RecordReader`` in one sequential pass. A record belongs to the shard
+    whose [begin, end) byte range contains its (8-aligned) start offset —
+    the same membership rule the reader's resync/stop conditions implement.
+
+    A ``<rec>.idx`` offset index (written by tools/im2rec.py /
+    RecordWriter.write_index) answers this from the tiny index file alone.
+    Without one, headers are parsed out of large buffered chunks —
+    ~size/1MB sequential reads, which for a big multi-rank remote dataset
+    means every rank streams the file once at init; pack with im2rec (or
+    call write_index) to avoid that.
+
+    """
+    size = getsize(path)
+    bounds = [size * k // nsplit for k in range(1, nsplit + 1)]
+    counts = [0] * nsplit
+    try:
+        with sopen(path + ".idx", "rb") as f:
+            offsets = [int(line) for line in f.read().split() if line]
+    except (OSError, ValueError):
+        offsets = None
+    if offsets is not None and offsets == sorted(offsets) \
+            and all(0 <= o < size for o in offsets):
+        part = 0
+        for o in offsets:
+            while o >= bounds[part]:
+                part += 1
+            counts[part] += 1
+        return counts
+    chunk_size = 1 << 20
+    with sopen(path, "rb") as f:
+        pos, part = 0, 0
+        buf, buf_start = b"", 0
+        while True:
+            off = pos - buf_start
+            if off < 0 or off + _HDR.size > len(buf):
+                f.seek(pos)
+                buf = f.read(chunk_size)
+                buf_start = pos
+                off = 0
+                if len(buf) < _HDR.size:
+                    break
+            magic, ln = _HDR.unpack_from(buf, off)
+            if magic != MAGIC:
+                raise IOError(f"{path}: bad record magic at {pos}")
+            while pos >= bounds[part]:
+                part += 1
+            counts[part] += 1
+            pos += _HDR.size + ln + _pad8(ln)
+    return counts
 
 
 @dataclasses.dataclass

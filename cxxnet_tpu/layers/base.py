@@ -135,6 +135,12 @@ class ApplyCtx:
     # shard_map — layers whose statistics must be global (MoE aux loss)
     # reduce over it too
     data_axis: Optional[str] = None
+    # bound inside the pipeline-parallel schedule (train only): layers with
+    # batch statistics (batch_norm) record raw microbatch moments here
+    # instead of updating running state — the schedule accumulates them
+    # across microbatches and the trainer merges one exact full-batch EMA
+    # update after the ring (see Network.apply_stage)
+    stat_sink: Optional[Dict[str, Any]] = None
 
 
 class Layer:

@@ -31,6 +31,10 @@ class ConvolutionLayer(Layer):
     floor((in + 2p - k)/stride) + 1 as in the reference (:174-178).
     """
     has_params = True
+    # pipeline-parallel manual tensor parallelism: output-channel weight
+    # slices per 'model' shard, activations all-gathered on the channel
+    # axis after apply (see Network.tp_manual_plan)
+    tp_manual_axis = -1
 
     def infer_shapes(self, in_shapes: List[Shape3]) -> List[Shape3]:
         self.check_n(in_shapes, 1, 1)

@@ -37,6 +37,9 @@ class FullConnectLayer(Layer):
     for a row-major activations matmul on the MXU.
     """
     has_params = True
+    # pipeline-parallel manual tensor parallelism: column-parallel weight
+    # slices per 'model' shard, outputs all-gathered on the feature axis
+    tp_manual_axis = -1
 
     def infer_shapes(self, in_shapes: List[Shape3]) -> List[Shape3]:
         self.check_n(in_shapes, 1, 1)

@@ -178,14 +178,22 @@ class MoELayer(Layer):
             combine = combine + d * gate[..., None, None]
 
         # dispatch -> per-expert capacity buffers, expert FFN, combine back.
-        # Under sp each shard contributes its tokens' (disjoint) slots and
-        # the buffers are summed across shards — the all-to-all analog —
-        # then every shard runs the expert FFN on the global buffers (the
-        # expert compute is replicated across seq shards; combine is local).
+        # Under sp the capacity axis is SHARDED across seq shards: a
+        # reduce-scatter hands each shard its C/sp slice of the global
+        # buffers (slots are per-expert positions, independent of which
+        # shard's token fills them), the expert FFN runs on the slice —
+        # cutting expert FLOPs and the (B,X,C,F) hidden activation by sp —
+        # and an all-gather of the (smaller) outputs feeds the local
+        # combine. sp=1 reduces to the plain dense path.
         ex_in = jnp.einsum("btxc,bte->bxce", dispatch,
                            x.astype(jnp.float32))
+        pad = 0
         if sp_ax is not None:
-            ex_in = lax.psum(ex_in, sp_ax)
+            pad = (-C) % sp
+            if pad:
+                ex_in = jnp.pad(ex_in, ((0, 0), (0, 0), (0, pad), (0, 0)))
+            ex_in = lax.psum_scatter(ex_in, sp_ax, scatter_dimension=2,
+                                     tiled=True)        # (B, X, C'/sp, E)
         ex_in = ex_in.astype(ctx.compute_dtype)
         h = jnp.einsum("bxce,xef->bxcf", ex_in,
                        params["h"]["wmat"].astype(ctx.compute_dtype))
@@ -194,6 +202,10 @@ class MoELayer(Layer):
         y = jnp.einsum("bxcf,xfe->bxce", h,
                        params["o"]["wmat"].astype(ctx.compute_dtype))
         y = y + params["o"]["bias"].astype(ctx.compute_dtype)[None, :, None, :]
+        if sp_ax is not None:
+            y = lax.all_gather(y, sp_ax, axis=2, tiled=True)
+            if pad:
+                y = y[:, :, :C, :]      # padded slots are never combined
         out = jnp.einsum("btxc,bxce->bte", combine,
                          y.astype(jnp.float32)).astype(ctx.compute_dtype)
 

@@ -34,6 +34,12 @@ from .base import Layer, is_flat, register_layer
 class _BatchNormBase(Layer):
     moving_avg = True
     has_params = True
+    # pipeline-parallel: BN is admissible in a pipeline body — train-time
+    # normalization uses microbatch-local statistics (the same semantics as
+    # the reference's per-GPU BN, batch_norm_layer-inl.hpp), while the raw
+    # moments are recorded into ctx.stat_sink so the trainer can make ONE
+    # exact full-batch running-stat update after the microbatch schedule
+    pp_batch_stats = True
 
     def set_param(self, name, val):
         if name == "init_slope":
@@ -85,11 +91,20 @@ class _BatchNormBase(Layer):
             inv = jax.lax.rsqrt(var + self.eps)
             out = (x - mean) * inv * slope + bias
             if self.moving_avg:
-                m = self.bn_momentum
-                state = {
-                    "running_exp": state["running_exp"] * m + mean * (1 - m),
-                    "running_var": state["running_var"] * m + var * (1 - m),
-                }
+                if ctx.stat_sink is not None:
+                    # pipeline body: hand raw moments to the schedule (the
+                    # trainer merges an exact full-batch EMA update after
+                    # the ring); state is untouched here
+                    ctx.stat_sink[self.name] = {
+                        "mean": mean, "sq": var + jnp.square(mean)}
+                else:
+                    m = self.bn_momentum
+                    state = {
+                        "running_exp": state["running_exp"] * m
+                        + mean * (1 - m),
+                        "running_var": state["running_var"] * m
+                        + var * (1 - m),
+                    }
             return [out.astype(x.dtype)], state
         if self.moving_avg:
             mean, var = state["running_exp"], state["running_var"]

@@ -125,7 +125,14 @@ class PosEmbedLayer(Layer):
     def apply(self, params, state, inputs, ctx):
         x = inputs[0]
         pe = params["wmat"].astype(ctx.compute_dtype)
-        return [x + pe.reshape(1, pe.shape[0], 1, pe.shape[1])], state
+        s_local = x.shape[1]
+        if ctx.seq_axis is not None and s_local != pe.shape[0]:
+            # sequence parallelism: the table is replicated but this shard
+            # holds tokens at a global offset — same offset arithmetic as
+            # the mha rope path
+            off = jax.lax.axis_index(ctx.seq_axis) * s_local
+            pe = jax.lax.dynamic_slice_in_dim(pe, off, s_local, axis=0)
+        return [x + pe.reshape(1, s_local, 1, pe.shape[1])], state
 
 
 class _SeqLinearMixin:

@@ -217,7 +217,11 @@ class LearnTask:
             batch_count = 0
             n_images = 0
             round_start = time.time()
-            for batch in itr_train:
+            # prefetch_device stages batch N+1's H2D + normalize while
+            # step N computes (device-side double buffering)
+            batches = (itr_train if self.test_io
+                       else tr.prefetch_device(itr_train))
+            for batch in batches:
                 if self.test_io:
                     n_images += batch.batch_size - batch.num_batch_padd
                     batch_count += 1

@@ -114,10 +114,16 @@ class Network:
               train: bool = False,
               capture_nodes: bool = False,
               seq_axis: Optional[str] = None,
-              data_axis: Optional[str] = None) -> ForwardResult:
+              data_axis: Optional[str] = None,
+              label_slices: Optional[Dict[Tuple[int, int],
+                                          jax.Array]] = None) -> ForwardResult:
         """One forward pass. ``data`` is NHWC (batch, y, x, c) or flat
         (batch,1,1,n); ``label`` is (batch, label_width); ``mask`` is (batch,)
-        marking real rows (None = all real)."""
+        marking real rows (None = all real). ``label_slices`` maps a loss
+        layer's global label_vec range to its (pre-sliced) label array —
+        used under sequence parallelism, where the full-width label cannot
+        be sliced locally with global indices (each shard holds its own
+        token-aligned columns of every slice)."""
         g = self.graph
         batch = data.shape[0]
         nodes: List[Optional[jax.Array]] = [None] * g.num_nodes
@@ -157,10 +163,13 @@ class Network:
                     total_loss = total_loss + lstate_out["_aux_loss"]
             for ni, out in zip(spec.nindex_out, outputs):
                 nodes[ni] = out
-            if layer.is_loss and label is not None:
+            if layer.is_loss and (label is not None
+                                  or label_slices is not None):
                 a, b = g.label_slice(layer.target)
+                lab = (label_slices[(a, b)] if label_slices is not None
+                       else label[:, a:b])
                 total_loss = total_loss + layer.loss(
-                    outputs, label[:, a:b].astype(jnp.float32), mask)
+                    outputs, lab.astype(jnp.float32), mask)
         node_map = None
         if capture_nodes:
             node_map = {name: nodes[i] for i, name in enumerate(g.node_names)
@@ -228,8 +237,13 @@ class Network:
             boundary_nodes.append(boundary)
             for li in range(lo, hi):
                 layer, spec = self.layers[li], g.layers[li]
-                if layer.has_state or layer.init_state(
-                        self._in_shapes_of[li]):
+                if ((layer.has_state or layer.init_state(
+                        self._in_shapes_of[li]))
+                        and not getattr(layer, "pp_batch_stats", False)):
+                    # batch_norm is admitted: its microbatch moments ride
+                    # the schedule's stat sink and merge after the ring.
+                    # Other stateful layers (e.g. moe, whose _aux_loss must
+                    # join the total loss) still cannot pipeline.
                     raise ValueError(
                         f"pipeline_parallel: stateful layer "
                         f"{spec.name!r} ({spec.type}) is not supported in "
@@ -257,28 +271,92 @@ class Network:
                 " all boundaries share one ppermute register")
         return ranges
 
+    def tp_manual_plan(self, tp_size: int) -> Dict[str, Dict[str, int]]:
+        """Static plan for MANUAL tensor parallelism inside pipeline stages:
+        {layer_name: {param_key: sharded_dim}} for every layer whose
+        param_pspecs put 'model' on a dim that divides evenly by
+        ``tp_size``. The pp step cannot leave the model axis to GSPMD —
+        automatic partitioning inserts model-axis collectives *inside*
+        lax.switch branches with module-wide rendezvous, which deadlocks
+        (devices in different stages never reach each other's ops). The
+        manual scheme slices each planned weight along its 'model' dim,
+        computes with the local shard, and all-gathers the layer output on
+        ``layer.tp_manual_axis`` — every collective stays scoped to the
+        model peers of one stage, which all execute the same branch."""
+        plan: Dict[str, Dict[str, int]] = {}
+        if tp_size <= 1:
+            return plan
+        for li, (spec, layer) in enumerate(zip(self.graph.layers,
+                                               self.layers)):
+            if (spec.is_shared or not layer.has_params
+                    or getattr(layer, "tp_manual_axis", None) is None):
+                continue
+            pspecs = layer.param_pspecs()
+            if not pspecs:
+                continue
+            dims = {key: d for key, ps in pspecs.items()
+                    for d, ax in enumerate(ps) if ax == "model"}
+            # divisibility check against the layer's actual param shapes
+            shapes = jax.eval_shape(
+                lambda _li=li: self.layers[_li].init_params(
+                    jax.random.PRNGKey(0), self._in_shapes_of[_li]))
+            if dims and all(key in shapes
+                            and shapes[key].shape[d] % tp_size == 0
+                            for key, d in dims.items()):
+                plan[layer.name] = dims
+        return plan
+
     def apply_stage(self, lo: int, hi: int, params: Params, x: jax.Array,
-                    rng: jax.Array, train: bool) -> jax.Array:
+                    rng: jax.Array, train: bool,
+                    state: Optional[NetState] = None,
+                    tp_axis: Optional[str] = None,
+                    tp_size: int = 1,
+                    tp_plan: Optional[Dict[str, Dict[str, int]]] = None
+                    ) -> Tuple[jax.Array, Dict[str, Any]]:
         """Run layers [lo, hi) on one microbatch: ``x`` is the raw data
-        (lo == 0) or the boundary activation. Returns the range's final
-        node value. Stage layers are stateless (enforced by
-        stage_partition)."""
+        (lo == 0) or the boundary activation. Returns ``(out, stats)``:
+        the range's final node value plus the raw microbatch moments of
+        any batch-stat layers (batch_norm) in the range — train only; the
+        pipeline schedule accumulates these and the trainer applies one
+        exact full-batch running-stat update after the ring. ``state`` is
+        read-only (eval-time BN running stats); never mutated."""
         g = self.graph
         nodes: Dict[int, jax.Array] = {}
         if lo == 0:
             nodes[0] = x
         else:
             nodes[g.layers[lo - 1].nindex_out[0]] = x
+        sink: Dict[str, Any] = {}
+        tp_plan = tp_plan or {}
         for li in range(lo, hi):
             spec, layer = g.layers[li], self.layers[li]
             ctx = ApplyCtx(train=train, rng=jax.random.fold_in(rng, li),
-                           compute_dtype=self.compute_dtype)
+                           compute_dtype=self.compute_dtype,
+                           stat_sink=sink if train else None)
             inputs = [nodes[ni] for ni in spec.nindex_in]
-            outputs, _ = layer.apply(params.get(layer.name, {}), {}, inputs,
-                                     ctx)
+            lstate = (state or {}).get(layer.name, {})
+            lparams = params.get(layer.name, {})
+            dims = tp_plan.get(layer.name)
+            if dims:
+                # manual tensor parallelism: this model shard computes a
+                # slice of the output channels with its weight slice, then
+                # all-gathers — a model-group-scoped collective that every
+                # model peer of this stage executes (see tp_manual_plan)
+                me = jax.lax.axis_index(tp_axis)
+                lparams = dict(lparams)
+                for key, d in dims.items():
+                    leaf = lparams[key]
+                    span = leaf.shape[d] // tp_size
+                    lparams[key] = jax.lax.dynamic_slice_in_dim(
+                        leaf, me * span, span, axis=d)
+            outputs, _ = layer.apply(lparams, lstate, inputs, ctx)
+            if dims:
+                ax = layer.tp_manual_axis % outputs[0].ndim
+                outputs = [jax.lax.all_gather(outputs[0], tp_axis,
+                                              axis=ax, tiled=True)]
             for ni, out in zip(spec.nindex_out, outputs):
                 nodes[ni] = out
-        return nodes[g.layers[hi - 1].nindex_out[0]]
+        return nodes[g.layers[hi - 1].nindex_out[0]], sink
 
     def apply_tail(self, body_hi: int, params: Params, state: NetState,
                    top: jax.Array, label: Optional[jax.Array],

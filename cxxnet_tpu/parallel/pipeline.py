@@ -24,6 +24,23 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+# The pvary helpers below probe varying-manual-axes APIs (jax.typeof().vma,
+# lax.pcast(..., to="varying"), lax.pvary) behind broad except clauses, and
+# the deadlock-avoidance scheme in pipeline_apply_stages depends on those
+# casts actually happening. Fail loudly on JAX versions where the probed
+# semantics were never validated instead of silently skipping the casts.
+_VALIDATED_JAX = ((0, 9), (0, 10))       # inclusive (minor-version) range
+_jax_ver = tuple(int(v) for v in jax.__version__.split(".")[:2])
+if not (_VALIDATED_JAX[0] <= _jax_ver <= _VALIDATED_JAX[1]):
+    raise ImportError(
+        f"cxxnet_tpu pipeline parallelism was validated on jax "
+        f"{_VALIDATED_JAX[0][0]}.{_VALIDATED_JAX[0][1]}–"
+        f"{_VALIDATED_JAX[1][0]}.{_VALIDATED_JAX[1][1]} only, found "
+        f"{jax.__version__}: the varying-axis casts it relies on "
+        f"(lax.pcast/pvary) are version-sensitive and load-bearing for "
+        f"collective ordering. Re-run tests/test_parallel_ext.py on this "
+        f"version, then widen _VALIDATED_JAX here.")
+
 
 def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
                    stage_params: Any, x: jax.Array, axis_name: str,
@@ -89,18 +106,27 @@ def pipeline_apply_stages(stage_fns, params: Any, x: jax.Array, aux: Any,
                           axis_name: str, n_microbatch: int,
                           boundary_sd, out_sd,
                           extra_vary_axes=(),
-                          grad_sum_axes=()):
+                          grad_sum_axes=(),
+                          stats_sd=None):
     """GPipe schedule over HETEROGENEOUS stages (the config-driven path).
 
-    ``stage_fns``: S callables. ``f_k(params, mb_input, m)`` — ``m`` is
-    the microbatch index (fold it into any dropout rng so masks differ
-    per microbatch). ``f_0`` ingests raw data microbatches; middle stages
-    ingest the boundary activation; the LAST stage is
-    ``f_{S-1}(params, inp, aux_mb, m) -> (y, scalar)`` — it also receives
-    its microbatch's slice of ``aux`` (labels/mask, any pytree with
-    leading dim M) and returns the final output plus a per-microbatch
-    scalar (the loss). Returns ``(out, scalar_sum)`` where ``scalar_sum``
-    accumulates the last stage's scalars over all M microbatches.
+    ``stage_fns``: S callables. ``f_k(params, mb_input, m) -> (y, stats)``
+    — ``m`` is the microbatch index (fold it into any dropout rng so masks
+    differ per microbatch). ``f_0`` ingests raw data microbatches; middle
+    stages ingest the boundary activation; the LAST stage is
+    ``f_{S-1}(params, inp, aux_mb, m) -> (y, scalar, stats)`` — it also
+    receives its microbatch's slice of ``aux`` (labels/mask, any pytree
+    with leading dim M) and returns the final output plus a per-microbatch
+    scalar (the loss). ``stats`` is a per-microbatch statistics pytree
+    (batch_norm moments) with the SAME structure from every stage
+    (``stats_sd`` — shape/dtype structs; pad entries a stage doesn't own
+    with zeros; pass ``{}``/None when no stage has stats). Returns
+    ``(out, scalar_sum, stats_sum)``: the last stage's scalars and every
+    stage's stats summed over the M live microbatch ticks (drain-tick
+    garbage is masked out) and psum'd over the pipe axis — so the caller
+    gets replicated per-layer totals it can turn into exact full-batch
+    moments. Stats receive no gradient (running statistics are auxiliary,
+    exactly like the unsharded step's has_aux state).
 
     Keeping the loss INSIDE the last stage matters: it makes every
     collective in the step data-dependent on the ring, so no independent
@@ -157,13 +183,20 @@ def pipeline_apply_stages(stage_fns, params: Any, x: jax.Array, aux: Any,
         except (AttributeError, TypeError):
             return lax.pvary(a, need)
 
+    if stats_sd is None:
+        stats_sd = {}
+
+    def zero_stats():
+        return jax.tree_util.tree_map(
+            lambda a: pvary(jnp.zeros(a.shape, a.dtype)), stats_sd)
+
     def aux_at(aux_, m):
         return jax.tree_util.tree_map(
             lambda a: a[jnp.clip(m, 0, M - 1)], aux_)
 
     def last_call(p, inp, aux_, m):
-        y, scalar = stage_fns[S - 1](p, inp, aux_at(aux_, m), m)
-        return y, jnp.asarray(scalar, jnp.float32)
+        y, scalar, st = stage_fns[S - 1](p, inp, aux_at(aux_, m), m)
+        return y, jnp.asarray(scalar, jnp.float32), st
 
     def forward(params, x, aux_):
         me = lax.axis_index(axis_name)
@@ -171,27 +204,39 @@ def pipeline_apply_stages(stage_fns, params: Any, x: jax.Array, aux: Any,
         reg0 = pvary(jnp.zeros(reg_shape, boundary_sd.dtype))
         out0 = pvary(jnp.zeros((M,) + out_shape, out_sd.dtype))
         loss0 = pvary(jnp.zeros((), jnp.float32))
+        stats0 = zero_stats()
 
         def tick(carry, t):
-            reg, out, loss = carry
+            reg, out, loss, stats = carry
             feed = jnp.where(t < M, t, M - 1)
             zero_reg = pvary(jnp.zeros(reg_shape, boundary_sd.dtype))
             zero_out = pvary(jnp.zeros(out_shape, out_sd.dtype))
 
             def branch(k):
                 def run(reg_in):
+                    # stage k holds a real microbatch only in this window;
+                    # fill/drain ticks recompute a clipped microbatch whose
+                    # stats must not contaminate the accumulator
+                    live_k = jnp.logical_and(t - k >= 0, t - k < M)
+
+                    def mask_stats(st):
+                        gate = jnp.where(live_k, 1.0, 0.0)
+                        return jax.tree_util.tree_map(
+                            lambda a: pvary(a * gate.astype(a.dtype)), st)
+
                     inp = pvary(xs[feed]) if k == 0 else reg_in
                     if k == S - 1:
-                        y, scalar = last_call(params, inp, aux_,
-                                              t - (S - 1))
+                        y, scalar, st = last_call(params, inp, aux_,
+                                                  t - (S - 1))
                         return (zero_reg, y.astype(zero_out.dtype),
-                                pvary(scalar))
-                    y = stage_fns[k](params, inp, t - k)
+                                pvary(scalar), mask_stats(st))
+                    y, st = stage_fns[k](params, inp, t - k)
                     return (y.astype(zero_reg.dtype), zero_out,
-                            pvary(jnp.zeros((), jnp.float32)))
+                            pvary(jnp.zeros((), jnp.float32)),
+                            mask_stats(st))
                 return run
 
-            reg_new, bank, scalar = lax.switch(
+            reg_new, bank, scalar, st_t = lax.switch(
                 me, [branch(k) for k in range(S)], reg)
             done_idx = jnp.clip(t - (S - 1), 0, M - 1)
             live = jnp.logical_and(me == S - 1, t >= S - 1)
@@ -202,31 +247,35 @@ def pipeline_apply_stages(stage_fns, params: Any, x: jax.Array, aux: Any,
                     (done_idx,) + (0,) * (o.ndim - 1)),
                 lambda o: o, out)
             loss = loss + jnp.where(live, scalar, 0.0)
+            stats = jax.tree_util.tree_map(jnp.add, stats, st_t)
             reg_next = lax.ppermute(reg_new, axis_name, perm)
-            return (reg_next, out, loss), reg    # save tick-ENTRY register
+            return (reg_next, out, loss, stats), reg  # save tick-ENTRY reg
 
-        (_, out, loss), regs = lax.scan(tick, (reg0, out0, loss0),
-                                        jnp.arange(T))
+        (_, out, loss, stats), regs = lax.scan(
+            tick, (reg0, out0, loss0, stats0), jnp.arange(T))
         # replicate the last stage's results to every pipe member. ONE psum
-        # for both values: separate psums would be data-independent and the
+        # for all values: separate psums would be data-independent and the
         # scheduler could interleave one with the backward ring (see the
-        # docstring's deadlock note)
-        out, loss = lax.psum(
+        # docstring's deadlock note). Each stage's stats live only on its
+        # own device (zeros elsewhere), so the psum is also the merge.
+        out, loss, stats = lax.psum(
             (out * jnp.where(me == S - 1, 1.0, 0.0).astype(out.dtype),
-             loss), axis_name)
-        return out.reshape(B, *out.shape[2:]), loss, regs
+             loss, stats), axis_name)
+        return out.reshape(B, *out.shape[2:]), loss, stats, regs
 
     @jax.custom_vjp
     def run(params, x, aux_):
-        out, loss, _ = forward(params, x, aux_)
-        return out, loss
+        out, loss, stats, _ = forward(params, x, aux_)
+        return out, loss, stats
 
     def run_fwd(params, x, aux_):
-        out, loss, regs = forward(params, x, aux_)
-        return (out, loss), (params, x, aux_, regs)
+        out, loss, stats, regs = forward(params, x, aux_)
+        return (out, loss, stats), (params, x, aux_, regs)
 
     def run_bwd(res, cot):
-        dout, dloss = cot                  # dloss replicated (loss is)
+        # dstats is discarded: running statistics are auxiliary outputs
+        # (the unsharded step's new_state is has_aux too, never a grad path)
+        dout, dloss, _dstats = cot         # dloss replicated (loss is)
         params, x, aux_, regs = res
         me = lax.axis_index(axis_name)
         xs = x.reshape(M, mb, *x.shape[1:])
@@ -268,8 +317,11 @@ def pipeline_apply_stages(stage_fns, params: Any, x: jax.Array, aux: Any,
                     # without any pvary in the traced function.
                     inp = pvary(xs[feed] if k == 0 else regs[t])
                     if k == S - 1:
+                        # [:2] drops the stats output (no cotangent; the
+                        # stats computation is DCE'd from the vjp trace)
                         _, vjp = jax.vjp(
-                            lambda pp, xx: last_call(pp, xx, aux_, m_last),
+                            lambda pp, xx: last_call(pp, xx, aux_,
+                                                     m_last)[:2],
                             pv_params, inp.astype(boundary_sd.dtype
                                                   if S > 1 else xs.dtype))
                         dp, dinp = vjp((pvary(dy_last),
@@ -279,7 +331,7 @@ def pipeline_apply_stages(stage_fns, params: Any, x: jax.Array, aux: Any,
                         live = jnp.logical_and(m >= 0, m < M)
                         dy = jnp.where(live, pvary(dreg_in), 0)
                         _, vjp = jax.vjp(
-                            lambda pp, xx: stage_fns[k](pp, xx, m).astype(
+                            lambda pp, xx: stage_fns[k](pp, xx, m)[0].astype(
                                 dy.dtype),
                             pv_params, inp.astype(
                                 xs.dtype if k == 0 else boundary_sd.dtype))

@@ -146,11 +146,12 @@ class Trainer:
             if self._sp > 1:
                 raise ValueError(
                     "pipeline_parallel with seq_parallel is not supported")
-            if self.mesh.model_parallel > 1:
-                raise ValueError(
-                    "pipeline_parallel with model_parallel is not "
-                    "supported yet (the pp step would silently replicate "
-                    "the TP axis)")
+            # model_parallel composes via MANUAL tensor parallelism:
+            # apply_stage slices fullc/conv weights per model shard and
+            # all-gathers outputs (Network.tp_manual_plan). GSPMD-auto
+            # model sharding is NOT an option here — it inserts
+            # module-wide collectives inside the lax.switch stage
+            # branches, which deadlocks (see tp_manual_plan's docstring)
             if self.graph.extra_data_num:
                 raise ValueError("pipeline_parallel does not support "
                                  "extra_data")
@@ -167,11 +168,12 @@ class Trainer:
             self._pp_ranges = self.net.stage_partition(self._pp)
 
     # Layers whose apply is correct on a local sequence shard under
-    # shard_map (mha switches to the ring path via ctx.seq_axis). posembed
-    # is excluded: its absolute table indexes global positions.
+    # shard_map (mha switches to the ring path, posembed offset-indexes
+    # its table via ctx.seq_axis).
     _SP_SAFE_LAYERS = frozenset({
-        "embed", "layernorm", "mha", "ffn", "seqfc", "add", "lmloss",
-        "moe", "relu", "sigmoid", "tanh", "softplus", "dropout", "share"})
+        "embed", "posembed", "layernorm", "mha", "ffn", "seqfc", "add",
+        "lmloss", "moe", "relu", "sigmoid", "tanh", "softplus", "dropout",
+        "share"})
 
     def _check_seq_parallel_ok(self) -> None:
         """seq_parallel (ring attention inside the config-driven step) is
@@ -181,7 +183,7 @@ class Trainer:
         if bad:
             raise ValueError(
                 f"seq_parallel: layer types {sorted(set(bad))} are not "
-                f"sequence-shardable (use rope for positions, not posembed)")
+                f"sequence-shardable")
         # model_parallel composes with seq_parallel: the shard_map is
         # partial-manual (('data','seq') manual, 'model' automatic), so
         # GSPMD still shards params/experts over 'model' inside the step
@@ -192,26 +194,31 @@ class Trainer:
             raise ValueError(
                 f"seq_parallel: input must be a flat (1,1,S) token node "
                 f"with S divisible by {self._sp}, got {(c, y, S)}")
-        if self.graph.label_width() % self._sp:
-            raise ValueError(
-                f"seq_parallel: label width {self.graph.label_width()} not "
-                f"divisible by {self._sp}")
-        # the label shards along its width, but loss layers slice it with
-        # global label_vec indices — only a single full-width slice maps
-        # cleanly onto shards
-        if self.graph.label_range != [(0, self.graph.label_width())]:
-            raise ValueError(
-                "seq_parallel requires a single full-width label slice "
-                f"(got label_vec ranges {self.graph.label_range})")
+        # labels are pre-sliced per label_vec range on the host and each
+        # slice is sharded over its width (token-aligned with the shard's
+        # sequence chunk), so multiple slices are fine — each just needs a
+        # width the seq axis divides
+        for a, b in self.graph.label_range:
+            if (b - a) % self._sp:
+                raise ValueError(
+                    f"seq_parallel: label_vec slice [{a},{b}) width "
+                    f"{b - a} not divisible by {self._sp}")
         # metric[label,node] bindings on non-top nodes are supported: the
         # sp train/eval steps capture them with (data, seq) out-specs
 
     # -- model lifecycle ---------------------------------------------------
+    def _param_pspecs(self):
+        """GSPMD placement specs for params. Under pipeline parallelism
+        the model axis is MANUAL inside the pp step (params enter
+        replicated and are sliced per shard in apply_stage), so host-side
+        model sharding is disabled there."""
+        return {} if self._pp > 1 else self.net.param_pspecs()
+
     def _place(self, params, net_state=None, opt_state=None):
         """Shard params (TP specs from the layers; size-1 model axis =
         replicated), mirror the sharding onto optimizer state, replicate
         the small net state."""
-        pspecs = self.net.param_pspecs()
+        pspecs = self._param_pspecs()
         out = [self.mesh.shard_params(params, pspecs)]
         if net_state is not None:
             out.append(self.mesh.replicate(net_state))
@@ -224,7 +231,7 @@ class Trainer:
         if self.update_period > 1:
             self.accum = self.mesh.shard_params(
                 jax.tree_util.tree_map(jnp.zeros_like, params),
-                self.net.param_pspecs())
+                self._param_pspecs())
 
     def init_model(self) -> None:
         params, net_state = self.net.init(self._base_key)
@@ -365,14 +372,20 @@ class Trainer:
         return sorted({n for n in self._metric_nodes if n is not None})
 
     def _shard_seq_batch(self, data, label=None):
-        """Place batch arrays with the sequence axis sharded (token inputs
-        (b,1,1,S) and (b,S)-wide labels)."""
+        """Place batch arrays with the sequence axis sharded: token inputs
+        (b,1,1,S), and the label pre-sliced per label_vec range with each
+        slice sharded over its width — the host-side slicing is what lets
+        every shard hold the token-aligned columns of EVERY slice (a
+        global [a,b) slice of a width-sharded label would not be local)."""
         from jax.sharding import PartitionSpec as P
         out = [jax.device_put(data, self.mesh.named(
             P(self.mesh.data_axis, None, None, self.mesh.seq_axis)))]
         if label is not None:
-            out.append(jax.device_put(label, self.mesh.named(
-                P(self.mesh.data_axis, self.mesh.seq_axis))))
+            sh = self.mesh.named(P(self.mesh.data_axis, self.mesh.seq_axis))
+            label = np.asarray(label)
+            out.append(tuple(
+                jax.device_put(np.ascontiguousarray(label[:, a:b]), sh)
+                for a, b in self.graph.label_range))
         return out if len(out) != 1 else out[0]
 
     def _make_sp_train_step(self, do_update: bool):
@@ -389,6 +402,8 @@ class Trainer:
         needed = self._needed_nodes()
         capture = bool(needed)
 
+        ranges = list(self.graph.label_range)
+
         def step(params, opt_state, net_state, accum, data, label, mask,
                  rng, sched):
             # decorrelate dropout across shards: fold both shard indices
@@ -396,11 +411,13 @@ class Trainer:
             rng_l = jax.random.fold_in(
                 jax.random.fold_in(rng, jax.lax.axis_index(data_axis)),
                 jax.lax.axis_index(seq_axis))
+            lslices = dict(zip(ranges, label))
 
             def loss_fn(p):
-                res = net.apply(p, net_state, data, label, mask, rng=rng_l,
+                res = net.apply(p, net_state, data, None, mask, rng=rng_l,
                                 train=True, seq_axis=seq_axis,
-                                data_axis=data_axis, capture_nodes=capture)
+                                data_axis=data_axis, capture_nodes=capture,
+                                label_slices=lslices)
                 loss = jax.lax.pmean(
                     jax.lax.pmean(res.loss, seq_axis), data_axis)
                 return loss, (res.state, _collect_nodes(res, needed))
@@ -428,23 +445,29 @@ class Trainer:
             step, mesh=self.mesh.mesh,
             in_specs=(rep, rep, rep, rep,
                       P(data_axis, None, None, seq_axis),
-                      P(data_axis, seq_axis), P(data_axis), rep, rep),
+                      tuple(P(data_axis, seq_axis) for _ in ranges),
+                      P(data_axis), rep, rep),
             out_specs=(rep, rep, rep, rep, rep, nodes_spec, rep),
             axis_names={data_axis, seq_axis})
         return jax.jit(wrapped, donate_argnums=(0, 1, 2, 3))
 
-    def _pp_probe_shapes(self, data_shape):
-        """Per-microbatch boundary and final-output ShapeDtypeStructs for
-        the pipeline ring register, via eval_shape over the stage chain."""
+    def _pp_probe_shapes(self, data_shape, train: bool = True):
+        """Per-microbatch boundary / final-output / batch-stat
+        ShapeDtypeStructs for the pipeline ring register, via eval_shape
+        over the stage chain. ``stats`` is the union of every stage's
+        batch_norm moment structure (train only; empty at eval)."""
         mb = data_shape[0] // self.mesh.data_parallel // self._pp_microbatch
         rng0 = jax.random.PRNGKey(0)
         W = self.graph.label_width()
         sd = jax.ShapeDtypeStruct((mb,) + tuple(data_shape[1:]), jnp.float32)
         boundary = None
+        stats: Dict[str, Any] = {}
         for lo, hi in self._pp_ranges[:-1]:
-            sd = jax.eval_shape(
-                lambda p, x, _lo=lo, _hi=hi: self.net.apply_stage(
-                    _lo, _hi, p, x, rng0, True), self.params, sd)
+            sd, st = jax.eval_shape(
+                lambda p, s, x, _lo=lo, _hi=hi: self.net.apply_stage(
+                    _lo, _hi, p, x, rng0, train, s),
+                self.params, self.net_state, sd)
+            stats.update(st)
             if boundary is None:
                 boundary = sd
         lo, hi = self._pp_ranges[-1]
@@ -452,78 +475,160 @@ class Trainer:
         lab = jax.ShapeDtypeStruct((mb, W), jnp.float32)
         msk = jax.ShapeDtypeStruct((mb,), jnp.float32)
 
-        def last(p, x, label, mask):
-            y = self.net.apply_stage(lo, hi, p, x, rng0, True)
+        def last(p, s, x, label, mask):
+            y, st = self.net.apply_stage(lo, hi, p, x, rng0, train, s)
             res = self.net.apply_tail(n_body, p, {}, y, label, mask, rng0,
-                                      True)
-            return res.out
-        out = jax.eval_shape(last, self.params, sd, lab, msk)
+                                      train)
+            return res.out, st
+        out, st = jax.eval_shape(last, self.params, self.net_state, sd, lab,
+                                 msk)
+        stats.update(st)
         strip = lambda a: jax.ShapeDtypeStruct(tuple(a.shape)[1:], a.dtype)
-        return strip(boundary), strip(out)
+        return strip(boundary), strip(out), stats
 
     def _pp_pipeline_fn(self, data_shape, train: bool):
         """Local GPipe body (runs under shard_map): the stage schedule over
         the 'pipe' axis on this device's batch rows, with the loss layers
         folded into the LAST stage so all collectives chain off the ring
-        (parallel/pipeline.py pipeline_apply_stages)."""
+        (parallel/pipeline.py pipeline_apply_stages). ``state`` threads
+        read-only into the stages (batch_norm running stats at eval);
+        train-time BN moments come back in ``stats`` for the trainer's
+        post-ring merge."""
         from .parallel.pipeline import pipeline_apply_stages
         net, ranges = self.net, self._pp_ranges
         n_body = ranges[-1][1]
-        boundary_sd, out_sd = self._pp_probe_shapes(data_shape)
+        boundary_sd, out_sd, stats_sd = self._pp_probe_shapes(data_shape,
+                                                              train)
         pipe_axis, data_axis = self.mesh.pipe_axis, self.mesh.data_axis
+        model_axis, tp = self.mesh.model_axis, self.mesh.model_parallel
+        tp_plan = net.tp_manual_plan(tp)
+        tp_kw = dict(tp_axis=model_axis, tp_size=tp, tp_plan=tp_plan)
         M = self._pp_microbatch
 
-        def body(p, x, label, mask, rng):
+        def pad_stats(st):
+            # every stage must return the SAME stats structure through the
+            # lax.switch — fill the layers this stage doesn't own with zeros
+            return {
+                name: (st[name] if name in st else jax.tree_util.tree_map(
+                    lambda a: jnp.zeros(a.shape, a.dtype), sub))
+                for name, sub in stats_sd.items()}
+
+        def body(p, x, label, mask, rng, state):
             mb = x.shape[0] // M
             # fold the microbatch index into the rng so dropout masks are
             # independent across microbatches (they'd repeat otherwise)
             fns = [
-                (lambda pp_, xx, m, _lo=lo, _hi=hi: net.apply_stage(
-                    _lo, _hi, pp_, xx, jax.random.fold_in(rng, m), train))
+                (lambda pp_, xx, m, _lo=lo, _hi=hi: (lambda y_st: (
+                    y_st[0], pad_stats(y_st[1])))(net.apply_stage(
+                        _lo, _hi, pp_, xx, jax.random.fold_in(rng, m),
+                        train, state, **tp_kw)))
                 for lo, hi in ranges[:-1]]
             lo, hi = ranges[-1]
 
             def last_fn(pp_, xx, aux_mb, m):
                 label_mb, mask_mb = aux_mb
                 rng_m = jax.random.fold_in(rng, m)
-                y = net.apply_stage(lo, hi, pp_, xx, rng_m, train)
+                y, st = net.apply_stage(lo, hi, pp_, xx, rng_m, train, state,
+                                        **tp_kw)
                 res = net.apply_tail(n_body, pp_, {}, y, label_mb, mask_mb,
                                      rng_m, train)
-                return res.out, res.loss
+                return res.out, res.loss, pad_stats(st)
             fns.append(last_fn)
             aux = (label.reshape(M, mb, *label.shape[1:]),
                    mask.reshape(M, mb))
-            top, loss_sum = pipeline_apply_stages(
+            top, loss_sum, stats = pipeline_apply_stages(
                 fns, p, x, aux, pipe_axis, M, boundary_sd, out_sd,
-                extra_vary_axes=(data_axis,), grad_sum_axes=(data_axis,))
+                extra_vary_axes=(data_axis, model_axis),
+                grad_sum_axes=(data_axis,),
+                stats_sd=stats_sd)
             # each microbatch loss is a mean over its mb rows -> average
             # the M of them to match the non-pipelined per-batch loss
-            return top, loss_sum / M
+            return top, loss_sum / M, stats
 
-        return body, out_sd
+        return body, out_sd, tp_plan
+
+    def _pp_bn_momenta(self) -> Dict[str, float]:
+        """bn_momentum per moving-average batch_norm layer — the post-ring
+        merge turns accumulated microbatch moments into ONE exact
+        full-batch EMA update (matching the unsharded step's single
+        per-batch update, not M per-microbatch ones)."""
+        out: Dict[str, float] = {}
+        for spec, layer in zip(self.graph.layers, self.net.layers):
+            if (not spec.is_shared
+                    and getattr(layer, "pp_batch_stats", False)
+                    and layer.moving_avg):
+                out[layer.name] = layer.bn_momentum
+        return out
 
     def _make_pp_train_step(self, do_update: bool, data_shape):
-        """Pipeline-parallel train step. The WHOLE step body runs under one
-        shard_map over ('data','pipe'); the custom-vjp backward schedule in
-        pipeline_apply_stages produces the grads (see its docstring for why
-        plain autodiff cannot)."""
+        """Pipeline-parallel train step. The WHOLE step body runs under
+        one FULLY-MANUAL shard_map over ('data','pipe','model'). Tensor
+        parallelism inside the stages is MANUAL — weight slices +
+        output all-gathers from Network.tp_manual_plan, with the grads
+        psum'd over 'model' here (GSPMD-auto model sharding would insert
+        collectives inside the switch branches and deadlock). The
+        custom-vjp backward schedule in pipeline_apply_stages produces
+        the grads (see its docstring for why plain autodiff cannot).
+        batch_norm layers normalize with microbatch-local statistics
+        (the reference's own per-GPU BN semantics,
+        batch_norm_layer-inl.hpp) while their running stats get one exact
+        global-batch update merged across microbatches AND data shards."""
         from jax.sharding import PartitionSpec as P
         net, opt, period = self.net, self.optimizer, self.update_period
         pipe_axis, data_axis = self.mesh.pipe_axis, self.mesh.data_axis
-        pipeline, out_sd = self._pp_pipeline_fn(data_shape, train=True)
+        model_axis = self.mesh.model_axis
+        pipeline, out_sd, tp_plan = self._pp_pipeline_fn(data_shape,
+                                                         train=True)
+        bn_ema = self._pp_bn_momenta()
+        M = self._pp_microbatch
         rep = P()
 
         def step(params, opt_state, net_state, accum, data, label, mask,
                  rng, sched):
             def loss_fn(p):
-                top, loss = pipeline(p, data, label, mask, rng)
-                return jax.lax.pmean(loss, data_axis), top
-            (loss, out), grads = jax.value_and_grad(
+                top, loss, stats = pipeline(p, data, label, mask, rng,
+                                            net_state)
+                # pmean over 'model' BEFORE differentiating: the vjp then
+                # seeds 1/tp per model peer, so the per-peer cotangent
+                # contributions (routed through the manual all-gather
+                # transposes) sum to exactly the true gradient — the same
+                # seed/psum pairing the data axis uses
+                return jax.lax.pmean(loss, (data_axis, model_axis)), (top,
+                                                                      stats)
+            (loss, (out, stats)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
+            # manual-tp grad merge: psum over 'model' for EVERY leaf —
+            # planned leaves hold partial (zero-padded slice) grads,
+            # unplanned leaves hold 1/tp-scaled replicas; both sum to the
+            # exact gradient (and become invariant for the out_specs).
+            # Free when the model axis is size 1.
+            grads = jax.tree_util.tree_map(
+                lambda v: jax.lax.psum(v, model_axis), grads)
+            # model peers compute identical outputs (activations are
+            # all-gathered); pmean makes them invariant for the out_specs
+            out = jax.lax.pmean(out, model_axis)
+            new_state = net_state
+            if bn_ema:
+                # stats arrive summed over the M live microbatches and
+                # psum'd over 'pipe'; average across data shards too, then
+                # E[x] = sum(mean_m)/M, Var = E[x^2] - E[x]^2 — exactly the
+                # full-global-batch moments (equal-size microbatches)
+                stats = jax.lax.pmean(stats, (data_axis, model_axis))
+                new_state = dict(net_state)
+                for name, mom in bn_ema.items():
+                    mean = stats[name]["mean"] / M
+                    var = stats[name]["sq"] / M - jnp.square(mean)
+                    st = net_state[name]
+                    new_state[name] = {
+                        "running_exp": st["running_exp"] * mom
+                        + mean * (1 - mom),
+                        "running_var": st["running_var"] * mom
+                        + var * (1 - mom),
+                    }
             params, opt_state, accum = _apply_grads(
                 opt, period, do_update, params, opt_state, accum, grads,
                 sched)
-            return (params, opt_state, net_state, accum, loss, out,
+            return (params, opt_state, new_state, accum, loss, out,
                     jax.random.fold_in(rng, 1))
 
         ds = P(data_axis, *([None] * (len(data_shape) - 1)))
@@ -532,27 +637,31 @@ class Trainer:
             step, mesh=self.mesh.mesh,
             in_specs=(rep, rep, rep, rep, ds, P(data_axis), P(data_axis),
                       rep, rep),
-            out_specs=(rep, rep, rep, rep, rep, out_spec, rep))
+            out_specs=(rep, rep, rep, rep, rep, out_spec, rep),
+            axis_names={data_axis, pipe_axis, model_axis})
         return jax.jit(wrapped, donate_argnums=(0, 1, 2, 3))
 
     def _make_pp_eval_step(self, data_shape):
         from jax.sharding import PartitionSpec as P
-        data_axis = self.mesh.data_axis
-        pipeline, out_sd = self._pp_pipeline_fn(data_shape, train=False)
+        data_axis, pipe_axis = self.mesh.data_axis, self.mesh.pipe_axis
+        model_axis = self.mesh.model_axis
+        pipeline, out_sd, _ = self._pp_pipeline_fn(data_shape, train=False)
 
         def step(params, net_state, data):
             W = self.graph.label_width()
             label = jnp.zeros((data.shape[0], W), jnp.float32)
             mask = jnp.ones((data.shape[0],), jnp.float32)
-            top, _ = pipeline(params, data, label, mask,
-                              jax.random.PRNGKey(0))
-            return top
+            top, _, _ = pipeline(params, data, label, mask,
+                                 jax.random.PRNGKey(0), net_state)
+            return jax.lax.pmean(top, model_axis)
 
         ds = P(data_axis, *([None] * (len(data_shape) - 1)))
         out_spec = P(data_axis, *([None] * len(out_sd.shape)))
         wrapped = jax.shard_map(step, mesh=self.mesh.mesh,
                                 in_specs=(P(), P(), ds),
-                                out_specs=out_spec)
+                                out_specs=out_spec,
+                                axis_names={data_axis, pipe_axis,
+                                            model_axis})
         fn = jax.jit(wrapped)
         return lambda params, net_state, data: {_TOP: fn(params, net_state,
                                                          data)}
@@ -598,7 +707,9 @@ class Trainer:
         parallelism mode — one dispatch point for update() and the cost
         probe."""
         mode = "sp" if self._sp > 1 else "pp" if self._pp > 1 else "std"
-        key = (do_update, mode)
+        # the pp body closes over probe shapes derived from the batch shape;
+        # std/sp recompile via jit shape polymorphism, pp must key on it
+        key = (do_update, mode, np.shape(batch.data) if mode == "pp" else None)
         if key not in self._train_step_fns:
             if mode == "sp":
                 fn = self._make_sp_train_step(do_update)
@@ -610,9 +721,43 @@ class Trainer:
             self._train_step_fns[key] = fn
         return self._train_step_fns[key]
 
+    def stage_batch(self, batch: DataBatch) -> DataBatch:
+        """Asynchronously place a host batch on the mesh: shard + deferred
+        uint8 normalize, all dispatched without blocking (jax.device_put
+        and jitted calls return futures). Staging batch N+1 while step N
+        runs overlaps the H2D copy with compute — the reason the
+        reference's ThreadBufferIterator exists
+        (iter_batch_proc-inl.hpp:132-220), extended here to the device
+        boundary. ``update``/``predict`` accept staged batches as-is."""
+        if isinstance(batch.data, jax.Array):
+            return batch                              # already staged
+        if self._sp > 1:
+            data, label = self._shard_seq_batch(batch.data, batch.label)
+        else:
+            data, label = self.mesh.shard_batch(batch.data, batch.label)
+        data = self._device_normalize(data, batch)
+        extra = [self.mesh.shard_batch(e) for e in batch.extra_data]
+        return DataBatch(data=data, label=label,
+                         num_batch_padd=batch.num_batch_padd,
+                         inst_index=batch.inst_index, extra_data=extra,
+                         norm=None, host_label=batch.label)
+
+    def prefetch_device(self, it, depth: int = 2):
+        """Wrap a batch iterable so ``depth`` batches are staged on-device
+        ahead of consumption (device-side double buffering)."""
+        from collections import deque
+        q: "deque" = deque()
+        for b in it:
+            q.append(self.stage_batch(b))
+            if len(q) >= depth:
+                yield q.popleft()
+        while q:
+            yield q.popleft()
+
     def update(self, batch: DataBatch) -> None:
         """One minibatch forward/backward(+update) — reference Update
-        (nnet_impl-inl.hpp:157-202)."""
+        (nnet_impl-inl.hpp:157-202). ``batch`` may be a host batch or one
+        staged by ``stage_batch``/``prefetch_device``."""
         assert self.params is not None, "call init_model() first"
         do_update = (self.sample_counter + 1) % self.update_period == 0 \
             if self.update_period > 1 else True
@@ -622,9 +767,9 @@ class Trainer:
             self._rng_key = jax.random.fold_in(self._base_key,
                                                self._step_count)
         accum_in = self.accum if self.update_period > 1 else {}
+        staged = self.stage_batch(batch)
+        data, label = staged.data, staged.label
         if self._pp > 1:
-            data, label = self.mesh.shard_batch(batch.data, batch.label)
-            data = self._device_normalize(data, batch)
             (self.params, self.opt_state, self.net_state, accum, loss,
              top, self._rng_key) = step(
                  self.params, self.opt_state, self.net_state,
@@ -632,22 +777,17 @@ class Trainer:
                  self._sched_scalars())
             nodes = {_TOP: top}
         elif self._sp > 1:
-            data, label = self._shard_seq_batch(batch.data, batch.label)
-            data = self._device_normalize(data, batch)
             (self.params, self.opt_state, self.net_state, accum, loss,
              nodes, self._rng_key) = step(
                  self.params, self.opt_state, self.net_state,
                  accum_in, data, label, mask, self._rng_key,
                  self._sched_scalars())
         else:
-            data, label = self.mesh.shard_batch(batch.data, batch.label)
-            data = self._device_normalize(data, batch)
-            extra = tuple(self.mesh.shard_batch(e) for e in batch.extra_data)
             (self.params, self.opt_state, self.net_state, accum, loss,
              nodes, self._rng_key) = step(
                  self.params, self.opt_state, self.net_state,
-                 accum_in, data, label, mask, extra, self._rng_key,
-                 self._sched_scalars())
+                 accum_in, data, label, mask, tuple(staged.extra_data),
+                 self._rng_key, self._sched_scalars())
         if self.update_period > 1:
             self.accum = accum
         self._last_loss = loss
@@ -738,7 +878,8 @@ class Trainer:
         n_real = batch.batch_size - batch.num_batch_padd
         if n_real <= 0:
             return
-        label = np.asarray(batch.label)
+        label = np.asarray(batch.label if batch.host_label is None
+                           else batch.host_label)
         node_vals = {}
         node_labels = {}
         for key, arr in nodes.items():
@@ -797,9 +938,12 @@ class Trainer:
                 raise ValueError(
                     "pipeline_parallel supports extraction of the top node "
                     "only")
-            if self._eval_step_fn is None or self._eval_step_fn[0] != "pp":
+            # the pp body closes over the probe shapes, so a changed batch
+            # shape must rebuild rather than silently reuse a stale pipeline
+            pp_key = ("pp", np.shape(batch.data))
+            if self._eval_step_fn is None or self._eval_step_fn[0] != pp_key:
                 self._eval_step_fn = (
-                    "pp", self._make_pp_eval_step(np.shape(batch.data)))
+                    pp_key, self._make_pp_eval_step(np.shape(batch.data)))
             data = self._device_normalize(self.mesh.shard_batch(batch.data),
                                           batch)
             return self._eval_step_fn[1](self.params, self.net_state, data)

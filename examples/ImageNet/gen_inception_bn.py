@@ -71,8 +71,11 @@ class ConfWriter:
             self.line(f"  pad = {pad}")
 
     def inception(self, src: str, dst: str, name: str, c1: int, c3, cd3,
-                  pool_kind: str, proj: int, stride: int) -> None:
-        """One inception block: 4-way split -> branches -> channel concat."""
+                  pool_kind: str, proj: int, stride: int,
+                  stage: int | None = None) -> None:
+        """One inception block: 4-way split -> branches -> channel concat.
+        ``stage``: pipeline stage id stamped on the block's first layer
+        (the `stage = k` config dialect, trainer pipeline_parallel)."""
         self.line(f"##### inception {name} #####")
         branches = []
         tips = []
@@ -82,6 +85,8 @@ class ConfWriter:
         heads = {b: f"{name}.{b}.0" for b in branches}
         self.line(f"layer[{src}->{','.join(heads[b] for b in branches)}] "
                   f"= split:sp_{name}")
+        if stage is not None:
+            self.line(f"  stage = {stage}")
         if c1 > 0:
             t = f"{name}.b1.1"
             self.conv_bn_relu(heads['b1'], t, f"{name}_1x1", c1, 1)
@@ -117,7 +122,12 @@ class ConfWriter:
 
 def generate(scale: float = 1.0, image_size: int = 224,
              num_class: int = 1000, batch_size: int = 128,
-             with_data: bool = True, data_prefix: str = "data/imagenet") -> str:
+             with_data: bool = True, data_prefix: str = "data/imagenet",
+             stage_split: tuple = ()) -> str:
+    """``stage_split``: inception block names (e.g. ``("4a",)``) at which a
+    new pipeline stage begins — emits the `stage = k` dialect so the config
+    trains under ``pipeline_parallel`` (BN bodies are pipelinable: stats
+    merge through the schedule's stat sink)."""
     if image_size % 32:
         raise ValueError("image_size must be a multiple of 32")
     w = ConfWriter(scale)
@@ -149,9 +159,15 @@ def generate(scale: float = 1.0, image_size: int = 224,
     w.pool("s4", "i2", "stem2", "max", 3, 2)
     w.line()
     top = "i2"
+    cur_stage = 0
     for (name, c1, c3, cd3, pk, proj, stride) in INCEPTION_TABLE:
         dst = f"i_{name}"
-        w.inception(top, dst, name, c1, c3, cd3, pk, proj, stride)
+        stage = None
+        if name in stage_split:
+            cur_stage += 1
+            stage = cur_stage
+        w.inception(top, dst, name, c1, c3, cd3, pk, proj, stride,
+                    stage=stage)
         top = dst
     final = image_size // 32
     w.pool(top, "gap", "global", "avg", final, 1)

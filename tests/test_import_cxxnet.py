@@ -1,0 +1,205 @@
+"""tools/import_cxxnet.py: read the reference's binary .model format.
+
+The writer below is built straight from the reference's serialization code
+(nnet_impl-inl.hpp:98-103, nnet_config.h:129-146, param.h:15-53,
+convolution_layer-inl.hpp:38-52, batch_norm_layer-inl.hpp:72-78, mshadow
+SaveBinary = raw Shape + f32 data) with the REFERENCE's tensor layouts —
+fullc (out,in), conv (group, cout/g, cin/g*kh*kw) — so the importer's
+transposes are exercised against an independent encoding of the wire
+format, not against themselves."""
+
+import os
+import struct
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from cxxnet_tpu.config import parse_config_string
+from cxxnet_tpu.io.data import DataBatch
+from cxxnet_tpu.trainer import Trainer
+
+CONF = """
+netconfig=start
+layer[+1] = conv:cv1
+  kernel_size = 3
+  nchannel = 8
+  pad = 1
+layer[+1] = batch_norm:bn1
+layer[+1] = relu
+layer[+1] = conv:cv2
+  kernel_size = 3
+  nchannel = 8
+  ngroup = 2
+layer[+1] = prelu:pr1
+layer[+1] = max_pooling
+  kernel_size = 2
+layer[+1] = flatten
+layer[+1] = fullc:fc1
+  nhidden = 5
+layer[+0] = softmax
+netconfig=end
+input_shape = 3,8,8
+batch_size = 8
+eval_train = 0
+"""
+
+# (type_id, name) mirroring the conf — unnamed layers save nothing
+REF_LAYERS = [(10, "cv1"), (30, "bn1"), (3, ""), (10, "cv2"), (29, "pr1"),
+              (11, ""), (7, ""), (1, "fc1"), (2, "")]
+
+
+def _s(txt):
+    b = txt.encode()
+    return struct.pack("<Q", len(b)) + b
+
+
+def _ivec(v):
+    return struct.pack("<Q", len(v)) + np.asarray(v, "<i4").tobytes()
+
+
+def _tensor(a):
+    a = np.asarray(a, np.float32)
+    return (np.asarray(a.shape, "<u4").tobytes()
+            + np.ascontiguousarray(a, "<f4").tobytes())
+
+
+def _layer_param(**kw):
+    d = dict(num_hidden=0, init_sigma=0.01, init_sparse=10,
+             init_uniform=-1.0, init_bias=0.0, num_channel=0, random_type=0,
+             num_group=1, kernel_height=0, kernel_width=0, stride=1,
+             pad_y=0, pad_x=0, no_bias=0, temp_col_max=64 << 18, silent=0,
+             num_input_channel=0, num_input_node=0)
+    d.update(kw)
+    return struct.pack(
+        "<i f i f f 13i", d["num_hidden"], d["init_sigma"],
+        d["init_sparse"], d["init_uniform"], d["init_bias"],
+        d["num_channel"], d["random_type"], d["num_group"],
+        d["kernel_height"], d["kernel_width"], d["stride"], d["pad_y"],
+        d["pad_x"], d["no_bias"], d["temp_col_max"], d["silent"],
+        d["num_input_channel"], d["num_input_node"]) + b"\0" * (64 * 4)
+
+
+def write_reference_model(path, tensors, epoch=7):
+    """Encode ``tensors`` (reference layouts, keyed by layer name) as a
+    reference .model file for the CONF net above."""
+    num_layers = len(REF_LAYERS)
+    num_nodes = num_layers + 1
+    out = [struct.pack("<i", 0)]                        # net_type
+    out.append(struct.pack("<2i", num_nodes, num_layers))
+    out.append(np.asarray((3, 8, 8), "<u4").tobytes())  # input_shape z,y,x
+    out.append(struct.pack("<2i", 1, 0))                # init_end, extra=0
+    out.append(b"\0" * (31 * 4))                        # reserved
+    for i in range(num_nodes):
+        out.append(_s(f"node{i}"))
+    for i, (tid, name) in enumerate(REF_LAYERS):
+        out.append(struct.pack("<2i", tid, -1))
+        out.append(_s(name))
+        out.append(_ivec([i]))
+        out.append(_ivec([i + 1]))
+    out.append(struct.pack("<q", epoch))                # long epoch_counter
+
+    blob = []
+    t = tensors
+    blob.append(_layer_param(num_channel=8, kernel_height=3, kernel_width=3,
+                             pad_y=1, pad_x=1, num_input_channel=3))
+    blob.append(_tensor(t["cv1.wmat"]))                 # (1, 8, 3*3*3)
+    blob.append(_tensor(t["cv1.bias"]))
+    blob.append(_tensor(t["bn1.slope"]))                # bn: no LayerParam
+    blob.append(_tensor(t["bn1.bias"]))
+    blob.append(_tensor(t["bn1.running_exp"]))
+    blob.append(_tensor(t["bn1.running_var"]))
+    blob.append(_layer_param(num_channel=8, kernel_height=3, kernel_width=3,
+                             num_group=2, num_input_channel=8))
+    blob.append(_tensor(t["cv2.wmat"]))                 # (2, 4, 4*3*3)
+    blob.append(_tensor(t["cv2.bias"]))
+    blob.append(_tensor(t["pr1.slope"]))                # prelu: slope only
+    blob.append(_layer_param(num_hidden=5, num_input_node=t["fc1.wmat"]
+                             .shape[1]))
+    blob.append(_tensor(t["fc1.wmat"]))                 # (out, in)
+    blob.append(_tensor(t["fc1.bias"]))
+    blob_bytes = b"".join(blob)
+    out.append(struct.pack("<Q", len(blob_bytes)))
+    out.append(blob_bytes)
+    with open(path, "wb") as f:
+        f.write(b"".join(out))
+
+
+def _ref_tensors_from(tr):
+    """Re-encode a trainer's params/state in the REFERENCE layouts."""
+    def hwio_to_ref(w, g):
+        kh, kw, ci_g, co = w.shape
+        # inverse of import's (g,co/g,ci,kh,kw)->(kh,kw,ci,co) mapping
+        w5 = w.reshape(kh, kw, ci_g, g, co // g)
+        return np.transpose(w5, (3, 4, 2, 0, 1)).reshape(
+            g, co // g, ci_g * kh * kw)
+    return {
+        "cv1.wmat": hwio_to_ref(tr.get_weight("cv1", "wmat"), 1),
+        "cv1.bias": tr.get_weight("cv1", "bias"),
+        "bn1.slope": tr.get_weight("bn1", "wmat"),
+        "bn1.bias": tr.get_weight("bn1", "bias"),
+        "bn1.running_exp": tr.get_state("bn1", "running_exp"),
+        "bn1.running_var": tr.get_state("bn1", "running_var"),
+        "cv2.wmat": hwio_to_ref(tr.get_weight("cv2", "wmat"), 2),
+        "cv2.bias": tr.get_weight("cv2", "bias"),
+        "pr1.slope": tr.get_weight("pr1", "bias"),
+        "fc1.wmat": tr.get_weight("fc1", "wmat").T,
+        "fc1.bias": tr.get_weight("fc1", "bias"),
+    }
+
+
+def test_import_cxxnet_roundtrip(tmp_path, mesh8):
+    """A net exported to the reference wire format and re-imported through
+    tools/import_cxxnet.py must produce identical forward outputs (eval
+    mode exercises the BN running stats too)."""
+    from import_cxxnet import parse_cxxnet_model
+    from import_weights import import_weights
+
+    cfg = parse_config_string(CONF)
+    src = Trainer(cfg, mesh_ctx=mesh8)
+    src.init_model()
+    # non-trivial BN running stats so eval depends on imported state
+    rng = np.random.RandomState(0)
+    b = DataBatch(data=rng.randn(8, 8, 8, 3).astype(np.float32),
+                  label=rng.randint(0, 5, (8, 1)).astype(np.float32))
+    for _ in range(3):
+        src.update(b)
+
+    ref_path = str(tmp_path / "ref.model")
+    write_reference_model(ref_path, _ref_tensors_from(src))
+
+    # structural parse
+    info, weights = parse_cxxnet_model(ref_path)
+    assert info["epoch"] == 7
+    assert info["input_shape"] == (3, 8, 8)
+    assert [l["type"] for l in info["layers"]][:2] == ["conv", "batch_norm"]
+    assert weights["fc1.wmat"].shape == src.get_weight("fc1", "wmat").shape
+    assert weights["cv2.wmat"].shape == (3, 3, 4, 8)    # grouped HWIO
+
+    # full import through the name-matched path
+    conf_path = str(tmp_path / "net.conf")
+    with open(conf_path, "w") as f:
+        f.write(CONF)
+    out_path = str(tmp_path / "imported.model")
+    n = import_weights(conf_path, ref_path, out_path, fmt="cxxnet",
+                       strict=True, verbose=False)
+    assert n == 11                                     # 9 params + 2 states
+
+    dst = Trainer(cfg, mesh_ctx=mesh8)
+    dst.init_model()
+    dst.load_model(out_path)
+    np.testing.assert_allclose(
+        np.asarray(dst.predict_raw(b)), np.asarray(src.predict_raw(b)),
+        rtol=1e-5, atol=1e-6)
+
+
+def test_import_cxxnet_rejects_truncated(tmp_path):
+    from import_cxxnet import parse_cxxnet_model
+    p = str(tmp_path / "bad.model")
+    with open(p, "wb") as f:
+        f.write(b"\0" * 40)
+    with pytest.raises(ValueError, match="truncated"):
+        parse_cxxnet_model(p)

@@ -210,8 +210,12 @@ def test_imgrec_mean_and_labels(rec_file, tmp_path):
     b = next(iter(it))
     assert b.label.shape == (20, 1)
     assert set(b.label[:, 0]) == {0.0, 1.0, 2.0, 3.0}
-    # mean-subtracted data should be roughly centered
-    assert abs(float(b.data.mean())) < 30.0
+    # mean subtraction centers the data — applied host-side, or deferred
+    # to the device under the auto uint8 path (norm carries the mean)
+    data = b.data.astype(np.float32)
+    if b.norm is not None and b.norm.get("mean") is not None:
+        data = data - np.asarray(b.norm["mean"], np.float32)
+    assert abs(float(data.mean())) < 30.0
 
 
 def test_im2rec_tool(tmp_path):
@@ -341,3 +345,184 @@ def test_recordio_shard_no_duplicates(tmp_path):
             ids += [ImageRecord.unpack(p).inst_id
                     for p in RecordReader(path, part, nsplit)]
         assert sorted(ids) == list(range(10)), (nsplit, sorted(ids))
+
+
+def test_shard_record_counts_matches_reader(tmp_path):
+    """The header-only counter must agree with what RecordReader actually
+    yields per (part, nsplit) shard, for lopsided record sizes too."""
+    from cxxnet_tpu.io.recordio import shard_record_counts
+    path = str(tmp_path / "lop.rec")
+    sizes = [5000, 40, 40, 40, 40, 40, 40, 40]
+    with RecordWriter(path) as w:
+        for i, s in enumerate(sizes):
+            w.write(ImageRecord(inst_id=i, labels=np.zeros(1, np.float32),
+                                data=b"z" * s).pack())
+    for nsplit in (1, 2, 3, 4, 8):
+        want = [sum(1 for _ in RecordReader(path, part, nsplit))
+                for part in range(nsplit)]
+        assert shard_record_counts(path, nsplit) == want, nsplit
+    assert sum(shard_record_counts(path, 4)) == len(sizes)
+
+
+def test_round_batch_unequal_shards_fail_fast(tmp_path):
+    """round_batch + nworker>1 must fail at init when byte-range sharding
+    gives ranks unequal per-epoch batch counts (the multi-host deadlock the
+    check exists to prevent), and pass when the counts are equal."""
+    path = str(tmp_path / "uneven.rec")
+    with RecordWriter(path) as w:
+        # one huge record then many small: shard 0 of 2 gets far fewer
+        w.write(ImageRecord(inst_id=0, labels=np.zeros(1, np.float32),
+                            data=_jpeg(_grad_img(200, 200))).pack())
+        for i in range(1, 9):
+            w.write(ImageRecord(inst_id=i, labels=np.zeros(1, np.float32),
+                                data=_jpeg(_grad_img(16, 16, i))).pack())
+
+    from cxxnet_tpu.io.recordio import shard_record_counts
+    counts = shard_record_counts(path, 2)
+    assert counts[0] != counts[1]          # the premise of the test
+
+    def make(rank, batch_size):
+        return create_iterator([
+            ("iter", "imgrec"),
+            ("image_rec", path),
+            ("input_shape", "3,16,16"),
+            ("batch_size", str(batch_size)),
+            ("round_batch", "1"),
+            ("dist_num_worker", "2"),
+            ("dist_worker_rank", str(rank)),
+            ("iter", "end"),
+        ])
+
+    # batch_size 1 -> per-rank batch counts equal the unequal record counts
+    with pytest.raises(ValueError, match="per-rank batch"):
+        make(0, 1)
+    # a batch size >= max shard makes every rank emit exactly 1 batch
+    it = make(0, max(counts))
+    assert len(list(it)) == 1
+
+
+def test_conf_prefix_without_placeholder_is_config_error():
+    from cxxnet_tpu.io.iter_imgrec import expand_conf_files
+    with pytest.raises(ValueError, match="image_conf_prefix"):
+        expand_conf_files("plain_path_no_placeholder", "1-4", 0, 1)
+    pairs = expand_conf_files("part%03d", "1-3", 0, 1)
+    assert pairs == [("part001.bin", "part001.lst"),
+                     ("part002.bin", "part002.lst"),
+                     ("part003.bin", "part003.lst")]
+
+
+def test_device_normalize_auto_default(rec_file, tmp_path):
+    """imgrec defaults to uint8 device-side normalization whenever it is
+    exact: crop/mirror-only -> uint8 + norm metadata; float-producing
+    augmentation (affine) or explicit device_normalize=0 -> host float."""
+    def first_batch(extra):
+        cfg = [
+            ("iter", "imgrec"),
+            ("image_rec", rec_file),
+            ("input_shape", "3,32,32"),
+            ("batch_size", "8"),
+            ("rand_crop", "1"),
+            ("rand_mirror", "1"),
+        ] + extra + [("iter", "end")]
+        return next(iter(create_iterator(cfg)))
+
+    b = first_batch([])
+    assert b.data.dtype == np.uint8 and b.norm is not None
+    b = first_batch([("max_rotate_angle", "15")])
+    assert b.data.dtype == np.float32 and b.norm is None
+    b = first_batch([("device_normalize", "0")])
+    assert b.data.dtype == np.float32 and b.norm is None
+
+    # raw float-tensor records must not be quantized by the auto default
+    raw = str(tmp_path / "raw.rec")
+    with RecordWriter(raw) as w:
+        rng = np.random.RandomState(0)
+        for i in range(4):
+            t = rng.randn(16, 16, 3).astype(np.float32)
+            w.write(ImageRecord(inst_id=i, labels=np.zeros(1, np.float32),
+                                data=t.tobytes(), flag=1).pack())
+    cfg = [
+        ("iter", "imgrec"),
+        ("image_rec", raw),
+        ("input_shape", "3,16,16"),
+        ("batch_size", "4"),
+        ("iter", "end"),
+    ]
+    b = next(iter(create_iterator(cfg)))
+    assert b.data.dtype == np.float32 and b.norm is None
+    assert b.data.min() < 0          # raw negative values survive
+
+
+def test_prefetch_device_matches_direct(rec_file, mesh8):
+    """Training through Trainer.prefetch_device (device-side double
+    buffering) must produce exactly the losses of direct host-batch
+    updates — staging is an execution overlap, not a data change."""
+    from cxxnet_tpu.trainer import Trainer
+    from cxxnet_tpu.config import parse_config_string
+
+    net_cfg = """
+netconfig=start
+layer[+1] = conv:c1
+  kernel_size = 3
+  nchannel = 8
+layer[+1] = relu
+layer[+1] = flatten
+layer[+1] = fullc:f1
+  nhidden = 4
+layer[+0] = softmax
+netconfig=end
+input_shape = 3,32,32
+batch_size = 8
+eta = 0.1
+eval_train = 0
+"""
+    data_cfg = [
+        ("iter", "imgrec"),
+        ("image_rec", rec_file),
+        ("input_shape", "3,32,32"),
+        ("batch_size", "8"),
+        ("iter", "end"),
+    ]
+
+    def run(prefetch):
+        tr = Trainer(parse_config_string(net_cfg), mesh_ctx=mesh8)
+        tr.init_model()
+        losses = []
+        for _ in range(2):
+            it = create_iterator(data_cfg)
+            src = tr.prefetch_device(it, depth=2) if prefetch else it
+            for b in src:
+                tr.update(b)
+                losses.append(float(tr.last_loss))
+        return losses
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-6)
+
+
+def test_shard_record_counts_uses_idx(tmp_path):
+    """A RecordWriter.write_index .idx sidecar must answer shard counts
+    identically to the full scan (and im2rec writes one)."""
+    from cxxnet_tpu.io.recordio import shard_record_counts
+    path = str(tmp_path / "ix.rec")
+    with RecordWriter(path) as w:
+        for i in range(9):
+            w.write(ImageRecord(inst_id=i, labels=np.zeros(1, np.float32),
+                                data=b"q" * (50 + 31 * i)).pack())
+        idx = w.write_index(path)
+    assert idx == path + ".idx"
+    with_idx = {n: shard_record_counts(path, n) for n in (2, 3, 4)}
+    os.rename(idx, idx + ".bak")          # force the scan fallback
+    scanned = {n: shard_record_counts(path, n) for n in (2, 3, 4)}
+    assert with_idx == scanned
+
+
+def test_conf_prefix_literal_percent_rejected():
+    """Prefixes that do not produce one distinct file per id must fail
+    fast for EVERY worker — even when a worker's slice holds a single
+    name, because all workers would silently train on identical data.
+    '%%d' raises at formatting; '%.0s' formats every id to the same name."""
+    from cxxnet_tpu.io.iter_imgrec import expand_conf_files
+    with pytest.raises(ValueError, match="printf-style"):
+        expand_conf_files("part%%d", "1-4", 0, 4)
+    with pytest.raises(ValueError, match="does not vary"):
+        expand_conf_files("part%.0s", "1-4", 0, 4)

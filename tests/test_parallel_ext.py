@@ -138,10 +138,152 @@ def test_pipeline_rejects_cross_stage_skip():
 
 
 def test_pipeline_rejects_stateful_body():
-    bad = PP_MLP_CFG.replace("layer[+1:a1] = relu",
-                             "layer[+1:a1] = batch_norm:bn")
+    """MoE's _aux_loss must join the total loss, which the microbatch
+    schedule cannot thread — still refused in a pipeline body."""
+    bad = MOE_LM_CFG.replace("layer[+1:nf] = layernorm:lnf",
+                             "layer[+1:nf] = layernorm:lnf\n  stage = 1")
     with pytest.raises(ValueError, match="stateful"):
         Trainer(parse_config_string(bad), mesh_ctx=_pp_mesh(pp=2, dp=2))
+
+
+PP_BN_CFG = """
+netconfig=start
+layer[+1:h1] = fullc:fc1
+  nhidden = 24
+  random_type = xavier
+layer[+1:b1] = batch_norm:bn1
+layer[+1:a1] = relu
+layer[+1:h2] = fullc:fc2
+  nhidden = 24
+  random_type = xavier
+  stage = 1
+layer[+1:b2] = batch_norm:bn2
+layer[+1:a2] = relu
+layer[a2->out] = fullc:fc3
+  nhidden = 5
+  random_type = xavier
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,12
+batch_size = 32
+eta = 0.2
+momentum = 0.9
+metric = error
+eval_train = 0
+"""
+
+
+def test_pipeline_bn_exact_match_single_microbatch():
+    """With ONE microbatch and dp=1, the pipeline's microbatch-local BN
+    statistics ARE the full-batch statistics — losses, params, and the
+    post-ring running-stat merge must all match the unsharded trainer
+    exactly (a BN net in each stage exercises the stat sink on every
+    pipe member)."""
+    cfg = parse_config_string(PP_BN_CFG)
+    tr_pp = Trainer(cfg + [("pipeline_microbatch", "1")],
+                    mesh_ctx=_pp_mesh(pp=2, dp=1))
+    tr_ref = Trainer(cfg, mesh_ctx=_pp_mesh(pp=1, dp=1))
+    tr_pp.init_model()
+    tr_ref.init_model()
+    it = create_iterator(parse_config_string(PP_ITER))
+    losses_pp, losses_ref = [], []
+    for _ in range(2):
+        for b in it:
+            tr_pp.update(b)
+            losses_pp.append(tr_pp.last_loss)
+        for b in it:
+            tr_ref.update(b)
+            losses_ref.append(tr_ref.last_loss)
+    np.testing.assert_allclose(losses_pp, losses_ref, rtol=2e-4)
+    for layer in ("fc1", "fc2", "fc3", "bn1", "bn2"):
+        np.testing.assert_allclose(
+            tr_pp.get_weight(layer, "wmat"), tr_ref.get_weight(layer, "wmat"),
+            rtol=2e-4, atol=1e-5)
+    for bn in ("bn1", "bn2"):
+        for k in ("running_exp", "running_var"):
+            np.testing.assert_allclose(
+                np.asarray(tr_pp.net_state[bn][k]),
+                np.asarray(tr_ref.net_state[bn][k]), rtol=1e-4, atol=1e-6)
+        assert float(np.abs(np.asarray(
+            tr_pp.net_state[bn]["running_exp"])).sum()) > 0
+
+
+def test_pipeline_bn_microbatched_trains_and_evals():
+    """M=4 microbatches: BN normalizes per microbatch (the reference's own
+    per-GPU BN semantics) — training must still learn, the merged running
+    stats must equal the unsharded full-batch moments for the FIRST BN
+    (its input data is identical regardless of schedule), and the eval
+    step must consume the running stats."""
+    cfg = parse_config_string(PP_BN_CFG)
+    tr_pp = Trainer(cfg + [("pipeline_microbatch", "4")],
+                    mesh_ctx=_pp_mesh(pp=2, dp=2))
+    tr_ref = Trainer(cfg, mesh_ctx=_pp_mesh(pp=1, dp=1))
+    tr_pp.init_model()
+    tr_ref.init_model()
+    it = create_iterator(parse_config_string(PP_ITER))
+    first = None
+    for _ in range(4):
+        for b in it:
+            tr_pp.update(b)
+            first = first if first is not None else tr_pp.last_loss
+    assert tr_pp.last_loss < 0.8 * first, (first, tr_pp.last_loss)
+    # one ref step on the same first batch: bn1's running stats see the
+    # same input rows, so the microbatch-merged moments must match the
+    # full-batch moments exactly (means/second-moments commute)
+    it.before_first()
+    b0 = it.next()
+    tr_ref.update(b0)
+    tr2 = Trainer(parse_config_string(PP_BN_CFG)
+                  + [("pipeline_microbatch", "4")],
+                  mesh_ctx=_pp_mesh(pp=2, dp=2))
+    tr2.init_model()
+    tr2.update(b0)
+    for k in ("running_exp", "running_var"):
+        np.testing.assert_allclose(
+            np.asarray(tr2.net_state["bn1"][k]),
+            np.asarray(tr_ref.net_state["bn1"][k]), rtol=1e-4, atol=1e-6)
+    # eval path reads the running stats through the pipeline stages
+    err = float(tr_pp.evaluate(it, "e").split(":")[-1])
+    assert 0.0 <= err <= 1.0
+    it.before_first()
+    assert tr_pp.predict(it.next()).shape == (32,)
+
+
+def test_pipeline_composes_with_tensor_parallel():
+    """pp x tp: MANUAL tensor parallelism inside the pipeline stages —
+    fullc/conv weights are sliced per model shard and outputs
+    all-gathered (model-group-scoped collectives; GSPMD-auto sharding
+    would insert module-wide collectives inside the switch branches and
+    deadlock). Same losses as the pp-only run: tp is an execution
+    strategy, not a model change."""
+    cfg = parse_config_string(PP_BN_CFG)
+    devs = jax.devices()
+    ctx_tp = make_mesh_context(devices=devs, pipeline_parallel=2,
+                               model_parallel=2)
+    assert ctx_tp.data_parallel == 2
+    tr_tp = Trainer(cfg + [("pipeline_microbatch", "4")], mesh_ctx=ctx_tp)
+    tr_pp = Trainer(cfg + [("pipeline_microbatch", "4")],
+                    mesh_ctx=_pp_mesh(pp=2, dp=2))
+    tr_tp.init_model()
+    tr_pp.init_model()
+    # the manual plan covers the fc weights (24 divides by tp=2)
+    plan = tr_tp.net.tp_manual_plan(2)
+    assert plan.get("fc1") == {"wmat": 1, "bias": 0}
+    assert "fc2" in plan
+    assert "fc3" not in plan      # nhidden=5 indivisible -> replicated
+    it = create_iterator(parse_config_string(PP_ITER))
+    losses_tp, losses_pp = [], []
+    for b in it:
+        tr_tp.update(b)
+        losses_tp.append(tr_tp.last_loss)
+    for b in it:
+        tr_pp.update(b)
+        losses_pp.append(tr_pp.last_loss)
+    np.testing.assert_allclose(losses_tp, losses_pp, rtol=5e-4)
+    # eval composes too
+    err_tp = float(tr_tp.evaluate(it, "e").split(":")[-1])
+    err_pp = float(tr_pp.evaluate(it, "e").split(":")[-1])
+    assert abs(err_tp - err_pp) < 0.05
 
 MOE_LM_CFG = f"""
 netconfig=start

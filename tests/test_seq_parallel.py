@@ -115,13 +115,25 @@ batch_size = 16
         Trainer(parse_config_string(conv_cfg), mesh_ctx=ctx)
 
 
-def test_sp_rejects_posembed():
-    cfg = LM_CFG.replace("layer[+1:n1] = layernorm:ln1",
-                         "layer[+1:pe] = posembed:pos\n"
-                         "layer[+1:n1] = layernorm:ln1")
-    ctx = make_mesh_context(devices=jax.devices(), seq_parallel=4)
-    with pytest.raises(ValueError, match="posembed"):
-        Trainer(parse_config_string(cfg), mesh_ctx=ctx)
+def test_sp_posembed_matches_sp1():
+    """posembed under seq_parallel: the replicated table is offset-indexed
+    per shard (global positions), so absolute position embeddings match
+    the unsharded run exactly — rope is no longer the only option."""
+    cfg = LM_CFG.replace("  rope = 1\n", "").replace(
+        "layer[+1:n1] = layernorm:ln1",
+        "layer[+1:pe] = posembed:pos\nlayer[+1:n1] = layernorm:ln1")
+    it = create_iterator(parse_config_string(ITER_CFG))
+    b = next(iter(it))
+    losses = {}
+    for sp in (1, 4):
+        ctx = make_mesh_context(devices=jax.devices(), seq_parallel=sp)
+        tr = Trainer(parse_config_string(cfg), mesh_ctx=ctx)
+        tr.init_model()
+        tr.update(b)
+        losses[sp] = float(tr.last_loss)
+        pe = tr.get_weight("pos", "wmat")
+        assert pe.shape == (S, 32)
+    assert abs(losses[1] - losses[4]) < 1e-5, losses
 
 
 def test_sp_with_moe_state():
@@ -211,11 +223,72 @@ def test_sp_moe_global_routing_matches_sp1():
     assert abs(losses[1] - losses[4]) < 1e-4, losses
 
 
-def test_sp_rejects_multi_slice_labels():
+def test_sp_multi_slice_labels_match_sp1():
+    """Multiple label_vec slices under seq_parallel: labels are pre-sliced
+    per range on the host and each slice sharded token-aligned, so two
+    loss heads with different slices train identically to sp=1."""
+    from cxxnet_tpu.io.data import DataBatch
     cfg = LM_CFG.replace(f"label_vec[0,{S}) = label",
                          f"label_vec[0,{S}) = la\nlabel_vec[{S},{2*S}) = lb")
-    cfg = cfg.replace("layer[+0] = lmloss",
-                      "layer[+0] = lmloss\n  target = la")
+    # the stock metric binds label_field "label", which no longer exists
+    cfg = cfg.replace("metric = seq_error", "eval_train = 0")
+    cfg = cfg.replace(
+        "layer[+1:lg] = seqfc:lm_head\n  nhidden = {V}".replace("{V}",
+                                                                str(V)),
+        f"layer[nf->lg] = seqfc:lm_head\n  nhidden = {V}\n"
+        f"layer[nf->lg2] = seqfc:aux_head\n  nhidden = {V}")
+    cfg = cfg.replace(
+        "layer[+0] = lmloss",
+        "layer[lg->lg] = lmloss\n  target = la\n"
+        "layer[lg2->lg2] = lmloss\n  target = lb\n  grad_scale = 0.5")
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, V, (16, S))
+    b = DataBatch(
+        data=toks.reshape(16, 1, 1, S).astype(np.float32),
+        label=np.concatenate([np.roll(toks, -1, axis=1),
+                              toks], axis=1).astype(np.float32))
+    losses = {}
+    for sp in (1, 4):
+        ctx = make_mesh_context(devices=jax.devices(), seq_parallel=sp)
+        tr = Trainer(parse_config_string(cfg), mesh_ctx=ctx)
+        tr.init_model()
+        tr.update(b)
+        tr.update(b)
+        losses[sp] = float(tr.last_loss)
+    assert abs(losses[1] - losses[4]) < 1e-5, losses
+    # a slice whose width the seq axis cannot divide still fails fast
+    bad = cfg.replace(f"label_vec[{S},{2*S}) = lb",
+                      f"label_vec[{S},{S+3}) = lb")
     ctx = make_mesh_context(devices=jax.devices(), seq_parallel=4)
-    with pytest.raises(ValueError, match="full-width label slice"):
-        Trainer(parse_config_string(cfg), mesh_ctx=ctx)
+    with pytest.raises(ValueError, match="not divisible"):
+        Trainer(parse_config_string(bad), mesh_ctx=ctx)
+
+
+def test_sp_moe_expert_capacity_sharded():
+    """The sp expert FFN is capacity-sharded: each seq shard computes only
+    C/sp capacity slots (reduce-scatter in, all-gather out) instead of
+    replicating the whole expert batch. Checks (a) the lowered sp step
+    really contains a reduce-scatter, (b) a capacity NOT divisible by sp
+    (zero-padded slots) still matches sp=1 exactly under forced drops."""
+    cfg = LM_CFG.replace(
+        "layer[+1:f1] = ffn:ffn1\n  nhidden = 64",
+        "layer[+1:f1] = moe:moe1\n  num_expert = 4\n  topk = 1\n"
+        "  capacity_factor = 0.75\n  nhidden = 64")   # C=6, sp=4 -> pad 2
+    it = create_iterator(parse_config_string(ITER_CFG))
+    b = next(iter(it))
+    losses = {}
+    for sp in (1, 4):
+        ctx = make_mesh_context(devices=jax.devices(), seq_parallel=sp)
+        tr = Trainer(parse_config_string(cfg), mesh_ctx=ctx)
+        tr.init_model()
+        tr.update(b)
+        losses[sp] = float(tr.last_loss)
+    assert abs(losses[1] - losses[4]) < 1e-4, losses
+    # structural: the sp train step lowers with a reduce-scatter (the
+    # capacity shard handoff), not just the psum a replicated FFN would use
+    step = tr._train_step_fns[(True, "sp", None)]
+    data, label = tr._shard_seq_batch(b.data, b.label)
+    txt = step.lower(tr.params, tr.opt_state, tr.net_state, {}, data,
+                     label, tr._mask(b), jax.random.PRNGKey(0),
+                     tr._sched_scalars()).as_text()
+    assert "reduce_scatter" in txt or "reduce-scatter" in txt

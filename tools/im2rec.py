@@ -77,7 +77,10 @@ def main() -> int:
                 n += 1
                 if n % 1000 == 0:
                     print(f"{path}: {n} images", flush=True)
-        print(f"wrote {path}: {n} images")
+            # offset index: lets distributed round_batch epoch checks run
+            # off the tiny .idx instead of scanning the whole .rec
+            w.write_index(path)
+        print(f"wrote {path}: {n} images (+ .idx)")
     return 0
 
 

@@ -1,0 +1,228 @@
+#!/usr/bin/env python
+"""Import a reference cxxnet binary ``.model`` checkpoint.
+
+The reference's pretrained-model workflow (README.md:31) ships models in
+its own binary format, written by CXXNetLearnTask::SaveModel /
+nnet_impl-inl.hpp:98-103:
+
+    int32   net_type                      (cxxnet_main.cpp:210)
+    NetConfig::SaveNet                    (nnet_config.h:129-146)
+        NetParam        raw 152-byte struct: i32 num_nodes, i32 num_layers,
+                        3 x u32 input_shape (mshadow::Shape3 z,y,x),
+                        i32 init_end, i32 extra_data_num, 31 x i32 reserved
+        extra_shape     dmlc vector<int> (u64 count + i32 data), only when
+                        extra_data_num != 0
+        node_names      num_nodes x dmlc string (u64 len + bytes)
+        per layer       i32 LayerType, i32 primary_layer_index,
+                        dmlc string name, dmlc vector<int> nindex_in,
+                        dmlc vector<int> nindex_out
+    int64   epoch_counter                 (long, nnet_impl-inl.hpp:101)
+    model_blob_  dmlc string wrapping the concatenation of every
+                 non-shared layer's SaveModel (neural_net-inl.hpp:56-65):
+        fullc       LayerParam (328 B) + wmat Tensor2 (out,in) + bias
+        conv        LayerParam + wmat Tensor3 (group, cout/g, cin/g*kh*kw)
+                    + bias          (convolution_layer-inl.hpp:38-52)
+        bias        LayerParam + bias Tensor1
+        batch_norm  slope + bias [+ running_exp + running_var] Tensor1s
+                    (batch_norm_layer-inl.hpp:72-78 — no LayerParam)
+        prelu       slope Tensor1  (prelu_layer-inl.hpp:93-95)
+        others      nothing (ILayer::SaveModel default is empty)
+    Tensors (mshadow SaveBinary): raw Shape<dim> (dim x u32) + f32 data.
+
+Weights land in this framework's conventions: fullc (out,in)->(in,out),
+conv NCHW-flattened filters -> HWIO, prelu slope -> key "bias", BN
+running stats -> layer state. Import goes through the same name-matched
+shape-checked path as tools/import_weights.py / import_caffe.py.
+
+Usage:
+  python tools/import_cxxnet.py <net.conf> <ref_model.bin> <out.model>
+      [--map src=dst ...] [--strict]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import struct
+import sys
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# layer.h:283-317 type codes
+LAYER_TYPES = {
+    0: "share", 1: "fullc", 2: "softmax", 3: "relu", 4: "sigmoid",
+    5: "tanh", 6: "softplus", 7: "flatten", 8: "dropout", 10: "conv",
+    11: "max_pooling", 12: "sum_pooling", 13: "avg_pooling", 15: "lrn",
+    17: "bias", 18: "concat", 19: "xelu", 20: "caffe",
+    21: "relu_max_pooling", 22: "maxout", 23: "split", 24: "insanity",
+    25: "insanity_max_pooling", 26: "lp_loss", 27: "multi_logistic",
+    28: "ch_concat", 29: "prelu", 30: "batch_norm", 31: "fixconn",
+    32: "batch_norm_no_ma",
+}
+PAIRTEST_GAP = 1024
+NET_PARAM_BYTES = 38 * 4      # nnet_config.h:28-49
+LAYER_PARAM = struct.Struct("<i f i f f 13i")   # param.h:15-53 (+64 reserved)
+LAYER_PARAM_BYTES = LAYER_PARAM.size + 64 * 4
+
+
+class _Reader:
+    """Sequential reader over bytes with the dmlc::Stream primitives."""
+
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def raw(self, n: int) -> bytes:
+        if self.pos + n > len(self.buf):
+            raise ValueError(
+                f"cxxnet model truncated at byte {self.pos} (+{n} wanted)")
+        out = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+    def i32(self) -> int:
+        return struct.unpack("<i", self.raw(4))[0]
+
+    def i64(self) -> int:
+        return struct.unpack("<q", self.raw(8))[0]
+
+    def u64(self) -> int:
+        return struct.unpack("<Q", self.raw(8))[0]
+
+    def string(self) -> str:
+        return self.raw(self.u64()).decode()
+
+    def ivec(self) -> List[int]:
+        n = self.u64()
+        return list(np.frombuffer(self.raw(4 * n), "<i4"))
+
+    def tensor(self, dim: int) -> np.ndarray:
+        """mshadow SaveBinary: raw Shape<dim> (dim x u32) + f32 data."""
+        shape = tuple(np.frombuffer(self.raw(4 * dim), "<u4").tolist())
+        n = int(np.prod(shape)) if shape else 0
+        data = np.frombuffer(self.raw(4 * n), "<f4").reshape(shape)
+        return data.copy()
+
+    @property
+    def eof(self) -> bool:
+        return self.pos >= len(self.buf)
+
+
+def _layer_param(r: _Reader) -> Dict[str, int]:
+    vals = LAYER_PARAM.unpack(r.raw(LAYER_PARAM.size))
+    r.raw(64 * 4)                                       # reserved[64]
+    keys = ("num_hidden", "init_sigma", "init_sparse", "init_uniform",
+            "init_bias", "num_channel", "random_type", "num_group",
+            "kernel_height", "kernel_width", "stride", "pad_y", "pad_x",
+            "no_bias", "temp_col_max", "silent", "num_input_channel",
+            "num_input_node")
+    return dict(zip(keys, vals))
+
+
+def _conv_to_hwio(w3: np.ndarray, lp: Dict[str, int]) -> np.ndarray:
+    """(group, cout/g, cin/g*kh*kw) -> HWIO (kh, kw, cin/g, cout).
+    The flattened filter dim is im2col channel-major (cin/g, kh, kw);
+    output channels are contiguous per group, matching HWIO with
+    feature_group_count (convolution_layer-inl.hpp:29-31)."""
+    g, co_g, flat = w3.shape
+    kh, kw = lp["kernel_height"], lp["kernel_width"]
+    ci_g = flat // (kh * kw)
+    if ci_g * kh * kw != flat:
+        raise ValueError(
+            f"conv filter dim {flat} does not factor as cin/g*{kh}*{kw}")
+    w = w3.reshape(g, co_g, ci_g, kh, kw)
+    return np.transpose(w, (3, 4, 2, 0, 1)).reshape(kh, kw, ci_g, g * co_g)
+
+
+def parse_cxxnet_model(path: str) -> Tuple[Dict, Dict[str, np.ndarray]]:
+    """Parse a reference ``.model`` file.
+
+    Returns ``(info, weights)``: ``info`` holds net_type/epoch/input_shape/
+    node_names/layers; ``weights`` maps ``"<layer>.<tag>"`` to arrays in
+    THIS framework's layouts (running stats included, for set_states)."""
+    with open(path, "rb") as f:
+        r = _Reader(f.read())
+    net_type = r.i32()
+    num_nodes, num_layers = r.i32(), r.i32()
+    input_shape = tuple(np.frombuffer(r.raw(12), "<u4").tolist())
+    init_end, extra_data_num = r.i32(), r.i32()
+    r.raw(31 * 4)                                       # NetParam reserved
+    if extra_data_num:
+        r.ivec()
+    node_names = [r.string() for _ in range(num_nodes)]
+    layers = []
+    for _ in range(num_layers):
+        t = r.i32()
+        layers.append({
+            "type_id": t,
+            "type": LAYER_TYPES.get(t, f"unknown<{t}>"),
+            "primary": r.i32(),
+            "name": r.string(),
+            "nin": r.ivec(),
+            "nout": r.ivec(),
+        })
+    epoch = r.i64()
+    blob = _Reader(r.raw(r.u64()))
+
+    weights: Dict[str, np.ndarray] = {}
+    for li, info in enumerate(layers):
+        t, name = info["type_id"], info["name"]
+        if t == 0:
+            continue                                    # kSharedLayer
+        if t >= PAIRTEST_GAP:
+            raise NotImplementedError(
+                "pairtest layers in a saved model are not supported "
+                f"(layer {li}, type {t})")
+        if not name:
+            name = f"layer{li}"
+        if t == 1:                                      # fullc
+            _layer_param(blob)
+            weights[f"{name}.wmat"] = blob.tensor(2).T.copy()   # (in,out)
+            weights[f"{name}.bias"] = blob.tensor(1)
+        elif t == 10:                                   # conv
+            lp = _layer_param(blob)
+            weights[f"{name}.wmat"] = _conv_to_hwio(blob.tensor(3), lp)
+            weights[f"{name}.bias"] = blob.tensor(1)
+        elif t == 17:                                   # bias layer
+            _layer_param(blob)
+            weights[f"{name}.bias"] = blob.tensor(1)
+        elif t in (30, 32):                             # batch_norm[_no_ma]
+            weights[f"{name}.wmat"] = blob.tensor(1)    # slope/gamma
+            weights[f"{name}.bias"] = blob.tensor(1)    # beta
+            if t == 30:
+                weights[f"{name}.running_exp"] = blob.tensor(1)
+                weights[f"{name}.running_var"] = blob.tensor(1)
+        elif t == 29:                                   # prelu
+            weights[f"{name}.bias"] = blob.tensor(1)    # slope under "bias"
+        # every other type writes nothing (ILayer::SaveModel default)
+    if not blob.eof:
+        raise ValueError(
+            f"cxxnet model blob has {len(blob.buf) - blob.pos} trailing "
+            "bytes — layer table and blob disagree (version mismatch?)")
+    info = {"net_type": net_type, "epoch": epoch,
+            "input_shape": input_shape, "node_names": node_names,
+            "layers": layers}
+    return info, weights
+
+
+def main(argv=None):
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from import_weights import import_weights
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("config", help="target net.conf")
+    ap.add_argument("source", help="reference cxxnet .model file")
+    ap.add_argument("output", help="output checkpoint path")
+    ap.add_argument("--map", action="append", default=[], metavar="SRC=DST")
+    ap.add_argument("--strict", action="store_true")
+    args = ap.parse_args(argv)
+    rename = dict(m.split("=", 1) for m in args.map)
+    import_weights(args.config, args.source, args.output, fmt="cxxnet",
+                   rename=rename, strict=args.strict)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -100,6 +100,11 @@ def import_weights(cfg_path: str, src_path: str, out_path: str,
         sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
         from import_caffe import caffe_to_keys, parse_caffemodel
         weights = caffe_to_keys(parse_caffemodel(src_path), rgb_flip=rgb_flip)
+    elif fmt == "cxxnet":
+        # the reference's own binary .model format (tools/import_cxxnet.py)
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from import_cxxnet import parse_cxxnet_model
+        _, weights = parse_cxxnet_model(src_path)
     else:
         weights = load_torch(src_path) if fmt == "torch" else load_npz(src_path)
     rename = dict(rename or {})
@@ -165,7 +170,8 @@ def main(argv=None):
     ap.add_argument("config")
     ap.add_argument("source")
     ap.add_argument("output")
-    ap.add_argument("--format", choices=("npz", "torch", "caffe"), default="")
+    ap.add_argument("--format", choices=("npz", "torch", "caffe", "cxxnet"),
+                    default="")
     ap.add_argument("--map", action="append", default=[],
                     metavar="SRC=DST", help="rename source layer SRC to DST")
     ap.add_argument("--strict", action="store_true",
