@@ -159,19 +159,35 @@ def shard_record_counts(path: str, nsplit: int) -> List[int]:
     size = getsize(path)
     bounds = [size * k // nsplit for k in range(1, nsplit + 1)]
     counts = [0] * nsplit
+    offsets = None
     try:
         with sopen(path + ".idx", "rb") as f:
             offsets = [int(line) for line in f.read().split() if line]
     except (OSError, ValueError):
-        offsets = None
-    if offsets is not None and offsets == sorted(offsets) \
+        pass
+    # trust the sidecar only when it provably describes THIS file: stale
+    # or truncated indexes (rec rewritten without the idx, interrupted
+    # pack) must fall through to the scan, or the round_batch deadlock
+    # check they feed would silently pass on wrong counts
+    if offsets and offsets == sorted(offsets) \
             and all(0 <= o < size for o in offsets):
-        part = 0
-        for o in offsets:
-            while o >= bounds[part]:
-                part += 1
-            counts[part] += 1
-        return counts
+        try:
+            with sopen(path, "rb") as f:
+                f.seek(offsets[0])
+                magic0, _ = _HDR.unpack(f.read(_HDR.size))
+                f.seek(offsets[-1])
+                magic1, ln = _HDR.unpack(f.read(_HDR.size))
+            last_end = offsets[-1] + _HDR.size + ln + _pad8(ln)
+            if (magic0 == MAGIC and magic1 == MAGIC and offsets[0] == 0
+                    and last_end == size):
+                part = 0
+                for o in offsets:
+                    while o >= bounds[part]:
+                        part += 1
+                    counts[part] += 1
+                return counts
+        except (OSError, struct.error):
+            pass
     chunk_size = 1 << 20
     with sopen(path, "rb") as f:
         pos, part = 0, 0

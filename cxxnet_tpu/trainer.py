@@ -721,16 +721,31 @@ class Trainer:
             self._train_step_fns[key] = fn
         return self._train_step_fns[key]
 
-    def stage_batch(self, batch: DataBatch) -> DataBatch:
+    def stage_batch(self, batch: DataBatch, for_eval: bool = False
+                    ) -> DataBatch:
         """Asynchronously place a host batch on the mesh: shard + deferred
         uint8 normalize, all dispatched without blocking (jax.device_put
         and jitted calls return futures). Staging batch N+1 while step N
         runs overlaps the H2D copy with compute — the reason the
         reference's ThreadBufferIterator exists
         (iter_batch_proc-inl.hpp:132-220), extended here to the device
-        boundary. ``update``/``predict`` accept staged batches as-is."""
+        boundary. ``update``/``predict`` accept staged batches as-is.
+        ``for_eval`` stages only the data: eval steps never consume the
+        label/extra arrays (metrics read labels host-side), so uploading
+        them would waste the bandwidth the prefetch exists to hide."""
         if isinstance(batch.data, jax.Array):
             return batch                              # already staged
+        if for_eval:
+            data = (self._shard_seq_batch(batch.data) if self._sp > 1
+                    else self.mesh.shard_batch(batch.data))
+            # extra_data IS consumed by the std eval step — stage it;
+            # _eval_nodes's re-shard of device arrays is a no-op
+            extra = [self.mesh.shard_batch(e) for e in batch.extra_data]
+            return DataBatch(data=self._device_normalize(data, batch),
+                             label=batch.label,
+                             num_batch_padd=batch.num_batch_padd,
+                             inst_index=batch.inst_index,
+                             extra_data=extra, norm=None)
         if self._sp > 1:
             data, label = self._shard_seq_batch(batch.data, batch.label)
         else:
@@ -742,13 +757,13 @@ class Trainer:
                          inst_index=batch.inst_index, extra_data=extra,
                          norm=None, host_label=batch.label)
 
-    def prefetch_device(self, it, depth: int = 2):
+    def prefetch_device(self, it, depth: int = 2, for_eval: bool = False):
         """Wrap a batch iterable so ``depth`` batches are staged on-device
         ahead of consumption (device-side double buffering)."""
         from collections import deque
         q: "deque" = deque()
         for b in it:
-            q.append(self.stage_batch(b))
+            q.append(self.stage_batch(b, for_eval=for_eval))
             if len(q) >= depth:
                 yield q.popleft()
         while q:
@@ -971,7 +986,9 @@ class Trainer:
         allreduce inside Metric::Get (metric.h:60-68)."""
         from .parallel import allreduce_metric_pairs
         self.metric.clear()
-        for batch in data_iter:
+        # prefetch: batch N+1's H2D overlaps batch N's host-side metric
+        # accumulation (_eval_nodes is a no-op re-stage for staged batches)
+        for batch in self.prefetch_device(data_iter, for_eval=True):
             nodes = self._eval_nodes(batch)
             self._add_metric(self.metric, nodes, batch)
         if jax.process_count() > 1:

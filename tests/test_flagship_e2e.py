@@ -1,0 +1,110 @@
+"""Flagship end-to-end: reduced Inception-BN through the REAL image data
+plane — jpegs on disk -> tools/im2rec.py pack -> imgrec shard/decode/augment
+-> uint8 H2D + device normalize -> train step — to convergence.
+
+This closes the loop the per-component tests cannot: the generated flagship
+graph (examples/ImageNet/gen_inception_bn.py), the production input
+pipeline, and the trainer learning TOGETHER on real data (sklearn's 1797
+UCI handwritten digits, upscaled to jpegs). The mnist-path accuracy
+evidence lives in tests/test_accuracy.py; this is the imgrec path.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "examples", "ImageNet"))
+
+from cxxnet_tpu.config import parse_config_string
+from cxxnet_tpu.io.data import create_iterator
+from cxxnet_tpu.trainer import Trainer
+
+IMG = 64          # smallest multiple of 32 the full block stack supports
+N_TRAIN, N_VAL = 600, 200
+
+
+@pytest.fixture(scope="module")
+def digits_recordio(tmp_path_factory):
+    """Real handwritten digits as jpegs, packed with the real packer."""
+    from PIL import Image
+    from sklearn.datasets import load_digits
+
+    d = load_digits()
+    rng = np.random.RandomState(0)
+    order = rng.permutation(len(d.images))
+    root = tmp_path_factory.mktemp("digits_jpg")
+    paths = {}
+    for split, idx in (("train", order[:N_TRAIN]),
+                       ("val", order[N_TRAIN:N_TRAIN + N_VAL])):
+        lines = []
+        for j, i in enumerate(idx):
+            # 8x8 [0,16] -> 32x32 RGB jpeg
+            a = np.clip(d.images[i] * 15.9375, 0, 255).astype(np.uint8)
+            img = Image.fromarray(a, "L").resize((IMG, IMG),
+                                                 Image.BILINEAR)
+            rel = f"{split}_{j}.jpg"
+            img.convert("RGB").save(os.path.join(root, rel), quality=95)
+            lines.append(f"{j}\t{int(d.target[i])}\t{rel}")
+        lst = os.path.join(root, f"{split}.lst")
+        with open(lst, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        rec = os.path.join(root, f"{split}.rec")
+        subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "im2rec.py"),
+             lst, str(root), rec], check=True, capture_output=True)
+        assert os.path.exists(rec) and os.path.exists(rec + ".idx")
+        paths[split] = rec
+    return paths
+
+
+def test_inception_bn_learns_through_imgrec(digits_recordio):
+    """Reduced Inception-BN + the full jpeg pipeline converge on real
+    digits: val error must beat a pinned threshold (chance = 0.9)."""
+    from gen_inception_bn import generate
+
+    txt = generate(scale=0.25, image_size=IMG, num_class=10, batch_size=40,
+                   with_data=False)
+    cfg = parse_config_string(txt) + [
+        ("eval_train", "0"),
+        ("compute_dtype", "float32"),     # CPU mesh: bf16 is TPU-side
+        ("dev", "cpu"),
+        ("eta", "0.1"),
+        # 15 steps/epoch: the default 0.9 EMA would lag the train stats
+        # and make eval noisy — faster tracking for the tiny dataset
+        ("bn_momentum", "0.5"),
+        ("metric", "error"),
+    ]
+    tr = Trainer(cfg)
+    tr.init_model()
+
+    def data_cfg(rec, train):
+        aug = ([("rand_mirror", "0"), ("rand_crop", "0")] if not train
+               else [("shuffle", "1"), ("seed_data", "3")])
+        return [
+            ("iter", "imgrec"),
+            ("image_rec", rec),
+            ("input_shape", f"3,{IMG},{IMG}"),
+            ("batch_size", "40"),
+            ("divideby", "255"),
+        ] + aug + [("iter", "threadbuffer"), ("iter", "end")]
+
+    train_cfg = data_cfg(digits_recordio["train"], train=True)
+    # the production path: uint8 batches + device-side normalization
+    probe = next(iter(create_iterator(train_cfg)))
+    assert probe.data.dtype == np.uint8 and probe.norm is not None
+
+    for _ in range(12):
+        it = create_iterator(train_cfg)
+        for b in tr.prefetch_device(it):
+            tr.update(b)
+
+    val = create_iterator(data_cfg(digits_recordio["val"], train=False))
+    err = float(tr.evaluate(val, "e").split(":")[-1])
+    # chance is 0.90; tuning runs reach ~0.11-0.14 by epoch 9-12. Pin a
+    # conservative bound so init/decode jitter doesn't flake CI while a
+    # real regression (pipeline or graph) still trips it.
+    assert err < 0.2, f"val error {err} (chance 0.9)"
