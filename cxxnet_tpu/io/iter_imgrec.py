@@ -189,23 +189,29 @@ class ImageRecordIterator(DataIter):
             # path for float-producing augmentations (affine/contrast/
             # illumination), raw float-tensor records (flag==1), and
             # images smaller than the crop (the upscale interpolates).
-            # The size check decodes only the first record; datasets that
-            # MIX sub-crop-size images behind a large first one should set
+            # The size check samples the shard's first few records (not
+            # just one — a large first image must not hide sub-crop-size
+            # ones behind it and silently switch the default's numerics);
+            # datasets mixing sizes deeper than the probe should set
             # device_normalize=0 explicitly.
             exact = (not self.aug.needs_affine
                      and self.aug.max_random_contrast == 0
                      and self.aug.max_random_illumination == 0)
             if exact:
-                rec = self._peek_record()
-                if rec is not None:
+                for rec in self._peek_records(8):
                     if rec.flag != 0:
                         exact = False
-                    else:
-                        img = self._decode(rec)
-                        _, y, x = self.input_shape
-                        if img.shape[0] < y or img.shape[1] < x:
-                            exact = False
+                        break
+                    img = self._decode(rec)
+                    _, y, x = self.input_shape
+                    if img.shape[0] < y or img.shape[1] < x:
+                        exact = False
+                        break
             self.aug.device_normalize = int(exact)
+            if not self.silent:
+                print(f"imgrec: device_normalize auto-resolved to "
+                      f"{self.aug.device_normalize} "
+                      f"({'uint8 device path' if exact else 'host float path'})")
         self._pool = futures.ThreadPoolExecutor(self.nthread)
         self._rng = np.random.RandomState(self.seed + 7 * self.rank)
         # monotonically increasing per-item augmentation counter, hashed
@@ -237,18 +243,21 @@ class ImageRecordIterator(DataIter):
                 "re-pack into equal-size parts (tools/im2bin.py) or use a "
                 "single recordio file (byte-range sharded)")
 
-    def _peek_record(self) -> Optional[ImageRecord]:
-        """First record of this worker's shard (None for an empty shard) —
-        init-time probe for the device_normalize auto-resolution."""
+    def _peek_records(self, n: int) -> List[ImageRecord]:
+        """First ``n`` records of this worker's shard (fewer for a short
+        shard) — init-time probe for the device_normalize auto-resolution."""
         reader = self._reader()
+        out: List[ImageRecord] = []
         try:
             for payload in reader:
-                return ImageRecord.unpack(payload)
+                out.append(ImageRecord.unpack(payload))
+                if len(out) >= n:
+                    break
         finally:
             close = getattr(reader, "close", None)
             if close is not None:
                 close()
-        return None
+        return out
 
     def _check_shard_batch_counts(self) -> None:
         """round_batch promises every rank the same number of batches per

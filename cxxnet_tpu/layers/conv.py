@@ -75,15 +75,69 @@ class ConvolutionLayer(Layer):
         # matmuls in f32 internally, and keeping activations in bf16
         # halves HBM traffic (mixed preferred_element_type would also break
         # the transpose/backward conv with mixed-dtype operands)
-        y = lax.conv_general_dilated(
-            x, w,
-            window_strides=(hp.stride, hp.stride),
-            padding=((hp.pad_y, hp.pad_y), (hp.pad_x, hp.pad_x)),
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            feature_group_count=hp.num_group)
+        if self._use_space_to_depth():
+            y = self._apply_s2d(x, w)
+        else:
+            y = lax.conv_general_dilated(
+                x, w,
+                window_strides=(hp.stride, hp.stride),
+                padding=((hp.pad_y, hp.pad_y), (hp.pad_x, hp.pad_x)),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                feature_group_count=hp.num_group)
         if "bias" in params:
             y = y + params["bias"].astype(y.dtype)
         return [y], state
+
+    def _use_space_to_depth(self) -> bool:
+        """Stem convs (cin<=4, stride>=2 — e.g. AlexNet's 11x11/4 on RGB)
+        run at ~13% of MXU peak lowered directly: 3 input channels leave
+        most of the 128-wide systolic rows idle. Re-expressing the conv on a
+        space-to-depth-blocked input (stride x stride patches folded into
+        channels; the standard public TPU stem trick, e.g. MLPerf ResNet)
+        packs s*s*cin channels instead and measures ~2x faster end-to-end
+        on v5e. Exact — the kernel is zero-padded to a stride multiple, so
+        extra taps contribute nothing."""
+        hp = self.hp
+        return (hp.num_group == 1 and hp.stride >= 2 and self._cin <= 4
+                and (hp.kernel_height > 1 or hp.kernel_width > 1))
+
+    def _apply_s2d(self, x, w):
+        """conv(x, w, stride=s) == conv(space_to_depth(x, s), blocked w, 1).
+
+        Geometry: with o = floor((H + 2p - k)/s) + 1 and k' = ceil(k/s),
+        repad the input to exactly H' = s*(o - 1 + k') rows (top pad p,
+        bottom pad/crop to fit — floor-mode tail rows are unused by the
+        conv, so cropping them is exact), zero-pad the kernel to s*k' taps,
+        then fold s x s blocks of both into channels: the resulting
+        stride-1 conv over (H'/s, W'/s, s*s*cin) visits exactly the
+        original windows. Weight stays in canonical HWIO (checkpoint/TP
+        layout unchanged); the fold is traced, so grads flow back to it."""
+        hp = self.hp
+        s = hp.stride
+        b, yy, xx, c = x.shape
+        # output channels from the weight, not hp.num_channel: under the
+        # pipeline path's manual tensor parallelism apply_stage hands us a
+        # cout/tp slice of the filter
+        cout = w.shape[-1]
+        kh, kw = hp.kernel_height, hp.kernel_width
+        kh2, kw2 = -(-kh // s) * s, -(-kw // s) * s    # ceil to stride
+        oy = (yy + 2 * hp.pad_y - kh) // s + 1
+        ox = (xx + 2 * hp.pad_x - kw) // s + 1
+        hp_y, hp_x = s * (oy - 1) + kh2, s * (ox - 1) + kw2
+        xp = jnp.pad(x, ((0, 0),
+                         (hp.pad_y, max(0, hp_y - yy - hp.pad_y)),
+                         (hp.pad_x, max(0, hp_x - xx - hp.pad_x)),
+                         (0, 0)))[:, :hp_y, :hp_x, :]
+        xs = xp.reshape(b, hp_y // s, s, hp_x // s, s, c)
+        xs = xs.transpose(0, 1, 3, 2, 4, 5).reshape(
+            b, hp_y // s, hp_x // s, s * s * c)
+        wp = jnp.pad(w, ((0, kh2 - kh), (0, kw2 - kw), (0, 0), (0, 0)))
+        ws = wp.reshape(kh2 // s, s, kw2 // s, s, c, cout)
+        ws = ws.transpose(0, 2, 1, 3, 4, 5).reshape(
+            kh2 // s, kw2 // s, s * s * c, cout)
+        return lax.conv_general_dilated(
+            xs, ws, window_strides=(1, 1), padding=((0, 0), (0, 0)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
     def param_pspecs(self):
         if self.hp.num_group > 1:
@@ -247,4 +301,14 @@ class LRNLayer(Layer):
         c = x.shape[-1]
         win = sum(padded[..., i:i + c] for i in range(self.nsize))
         norm = self.knorm + (self.alpha / self.nsize) * win
-        return [x * jnp.power(norm, -self.beta)], state
+        # norm**-beta as exp(-beta*log(norm)) — same lowering class but
+        # measurably faster than jnp.power's generic path on v5e, and
+        # norm >= knorm > 0 so the log is safe
+        out = x * jnp.exp(-self.beta * jnp.log(norm))
+        # fusion fence: without it XLA fuses this whole transcendental
+        # chain into a consumer conv's window computation (seen with
+        # AlexNet's lrn->grouped-conv pairs), recomputing the LRN once per
+        # kernel tap — measured 894 ms/step vs 15 ms with the barrier on a
+        # v5e. The barrier only pins the one intermediate; everything else
+        # still fuses.
+        return [lax.optimization_barrier(out)], state

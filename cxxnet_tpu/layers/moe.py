@@ -35,6 +35,20 @@ from .seq import _seq, _unseq
 class MoELayer(Layer):
     has_params = True
     has_state = True
+    # admissible in a pipeline-parallel body: the load-balance aux loss
+    # rides the schedule's per-stage scalar accumulator (differentiated —
+    # pipeline_apply_stages seeds every stage's scalar with the loss
+    # cotangent), written via ctx.stat_sink under key "_aux:<name>"
+    pp_aux_loss = True
+
+    def _emit_aux(self, aux, ctx):
+        """Deliver the aux loss: through the stat sink inside a pipeline
+        stage (Network.apply_stage discards layer state), as layer state
+        on the standard path (Network.apply adds state['_aux_loss'])."""
+        if ctx.stat_sink is not None:
+            ctx.stat_sink["_aux:" + self.name] = aux
+            return {}
+        return {"_aux_loss": aux}
 
     def set_param(self, name, val):
         if name == "num_expert":
@@ -145,7 +159,7 @@ class MoELayer(Layer):
             y = y + params["o"]["bias"].astype(ctx.compute_dtype)[None, None]
             out = jnp.einsum("btx,btxe->bte", w.astype(jnp.float32),
                              y.astype(jnp.float32)).astype(ctx.compute_dtype)
-            return [_unseq(out)], {"_aux_loss": aux}
+            return [_unseq(out)], self._emit_aux(aux, ctx)
 
         # position-in-expert via cumulative sum over tokens; tokens past the
         # capacity C are dropped (standard Switch behavior, keeps shapes
@@ -209,4 +223,4 @@ class MoELayer(Layer):
         out = jnp.einsum("btxc,bxce->bte", combine,
                          y.astype(jnp.float32)).astype(ctx.compute_dtype)
 
-        return [_unseq(out)], {"_aux_loss": aux}
+        return [_unseq(out)], self._emit_aux(aux, ctx)

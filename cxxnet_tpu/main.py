@@ -79,7 +79,12 @@ class LearnTask:
         self.model_in = gp("model_in", "NULL")
         self.continue_training = int(gp("continue", "0"))
         self.extract_node_name = gp("extract_node_name", "top")
-        self.name_pred = gp("name_pred", "pred.txt")
+        # the pred section's value IS the output filename (reference
+        # cxxnet_main.cpp:281-282: ``pred = test.txt``); explicit
+        # name_pred= still overrides
+        pred_name = next((name for kind, name, _ in self.sections
+                          if kind == "pred" and name), "")
+        self.name_pred = gp("name_pred", pred_name or "pred.txt")
         self.silent = int(gp("silent", "0"))
         # test_io=1: run the full input pipeline but skip Update — isolates
         # input throughput (reference cxxnet_main.cpp:455-469, doc/debug_perf.md)
@@ -88,6 +93,17 @@ class LearnTask:
         # (view with xprof/tensorboard); the reference prescribed external
         # tools only (doc/debug_perf.md) — built-in here
         self.profile_dir = gp("profile_dir", "")
+        # dev=cpu must be pinned BEFORE the first device query
+        # (jax.process_index below): a remote-attached accelerator plugin
+        # (axon tunnel) initializes eagerly on that query and a dead link
+        # hangs the whole process (mesh.py applies the same override for
+        # Trainer-only embedders)
+        if gp("dev", "").split(":")[0] == "cpu":
+            import jax
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except Exception:
+                pass
         # multi-host bring-up before any device queries (rabit::Init analog)
         from .parallel import maybe_distributed_init
         maybe_distributed_init(self.global_cfg)
@@ -174,6 +190,8 @@ class LearnTask:
             self.task_train()
         elif self.task == "pred":
             self.task_predict()
+        elif self.task == "pred_raw":
+            self.task_predict_raw()
         elif self.task in ("extract", "extract_feature"):
             self.task_extract()
         elif self.task == "get_weight":
@@ -269,6 +287,23 @@ class LearnTask:
                     f.write(f"{float(v):g}\n")
         if not self.silent:
             print(f"finished prediction, write into {self.name_pred}")
+
+    def task_predict_raw(self) -> None:
+        """Raw top-node rows (e.g. softmax probabilities), one instance per
+        line, space-separated — the format the kaggle_bowl submission
+        workflow consumes (reference example/kaggle_bowl/pred.conf's
+        ``task = pred_raw`` + make_submission.py)."""
+        tr = self.trainer
+        self._init_model()
+        itr = self.pred_iter() or self.train_iter()
+        if itr is None:
+            raise ValueError("no pred/data section in config")
+        with _text_out(self.name_pred) as f:
+            for batch in itr:
+                for row in tr.predict_raw(batch):
+                    f.write(" ".join(f"{float(v):g}" for v in row) + "\n")
+        if not self.silent:
+            print(f"finished raw prediction, write into {self.name_pred}")
 
     def task_extract(self) -> None:
         tr = self.trainer

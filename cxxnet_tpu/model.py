@@ -52,6 +52,7 @@ class Network:
         # recipe for memory-bound models (no reference analog; the closest
         # is temp_col_max's memory/compute staging, SURVEY §5)
         self.remat = bool(int(global_param(cfg, "remat", "0")))
+        self._tp_plan_logged = False
         # build layer objects; shared specs reuse the primary object
         self.layers: List[Layer] = []
         for spec in graph.layers:
@@ -239,11 +240,14 @@ class Network:
                 layer, spec = self.layers[li], g.layers[li]
                 if ((layer.has_state or layer.init_state(
                         self._in_shapes_of[li]))
-                        and not getattr(layer, "pp_batch_stats", False)):
+                        and not getattr(layer, "pp_batch_stats", False)
+                        and not getattr(layer, "pp_aux_loss", False)):
                     # batch_norm is admitted: its microbatch moments ride
                     # the schedule's stat sink and merge after the ring.
-                    # Other stateful layers (e.g. moe, whose _aux_loss must
-                    # join the total loss) still cannot pipeline.
+                    # moe is admitted: its _aux_loss rides the schedule's
+                    # per-stage scalar accumulator (differentiated).
+                    # Remaining stateful layers (e.g. insanity's annealing
+                    # counter) cannot pipeline.
                     raise ValueError(
                         f"pipeline_parallel: stateful layer "
                         f"{spec.name!r} ({spec.type}) is not supported in "
@@ -286,13 +290,18 @@ class Network:
         plan: Dict[str, Dict[str, int]] = {}
         if tp_size <= 1:
             return plan
+        excluded: List[Tuple[str, str]] = []
         for li, (spec, layer) in enumerate(zip(self.graph.layers,
                                                self.layers)):
-            if (spec.is_shared or not layer.has_params
-                    or getattr(layer, "tp_manual_axis", None) is None):
+            if spec.is_shared or not layer.has_params:
+                continue
+            if getattr(layer, "tp_manual_axis", None) is None:
+                excluded.append((layer.name, "no tp_manual_axis"))
                 continue
             pspecs = layer.param_pspecs()
             if not pspecs:
+                excluded.append((layer.name, "no 'model' pspec "
+                                 "(e.g. grouped conv)"))
                 continue
             dims = {key: d for key, ps in pspecs.items()
                     for d, ax in enumerate(ps) if ax == "model"}
@@ -304,6 +313,24 @@ class Network:
                             and shapes[key].shape[d] % tp_size == 0
                             for key, d in dims.items()):
                 plan[layer.name] = dims
+            else:
+                excluded.append((layer.name,
+                                 f"'model' dim not divisible by {tp_size}"))
+        # layers outside the plan compute replicated — say so once, loudly
+        # enough to explain a flat memory/throughput curve, quiet enough
+        # not to spam (grouped by reason, a few example names each)
+        if excluded and not self._tp_plan_logged:
+            self._tp_plan_logged = True
+            by_reason: Dict[str, List[str]] = {}
+            for n, why in excluded:
+                by_reason.setdefault(why, []).append(n)
+            detail = "; ".join(
+                f"{why}: {len(names)} ({', '.join(names[:4])}"
+                + (", ..." if len(names) > 4 else "") + ")"
+                for why, names in by_reason.items())
+            print(f"tp_manual_plan: {len(excluded)}/{len(self.layers)} "
+                  f"layer(s) compute replicated across the model axis "
+                  f"(tp={tp_size}) — {detail}")
         return plan
 
     def apply_stage(self, lo: int, hi: int, params: Params, x: jax.Array,

@@ -217,6 +217,16 @@ def make_mesh_context(dev: str = "tpu",
     so pure data-parallel code is unaffected."""
     if devices is None:
         idx = parse_device_spec(dev)
+        if dev.split(":")[0] == "cpu":
+            # dev=cpu must not touch the accelerator plugin at all:
+            # remote-attached backends (axon tunnel) initialize eagerly on
+            # the first device query and a dead link hangs it. The config
+            # knob is honored even where the JAX_PLATFORMS env var is
+            # overridden by site bootstrap.
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except Exception:
+                pass            # backends already initialized: use as-is
         all_devs = jax.devices()
         devices = all_devs if idx is None else [all_devs[i] for i in idx]
     n = len(devices)

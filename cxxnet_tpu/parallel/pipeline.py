@@ -17,6 +17,8 @@ API: stage parameters are pytrees with a leading stage axis (S, ...);
 from __future__ import annotations
 
 import functools
+import os
+import re
 from typing import Any, Callable
 
 import jax
@@ -30,8 +32,17 @@ from jax.sharding import Mesh, PartitionSpec as P
 # casts actually happening. Fail loudly on JAX versions where the probed
 # semantics were never validated instead of silently skipping the casts.
 _VALIDATED_JAX = ((0, 9), (0, 10))       # inclusive (minor-version) range
-_jax_ver = tuple(int(v) for v in jax.__version__.split(".")[:2])
-if not (_VALIDATED_JAX[0] <= _jax_ver <= _VALIDATED_JAX[1]):
+# tolerate suffixed components ('0.10rc1') — take the leading digits; a
+# completely non-numeric component counts as 0 so the gate still raises the
+# curated ImportError below rather than a bare ValueError at import time
+_jax_ver = tuple(
+    int(m.group()) if (m := re.match(r"\d+", v)) else 0
+    for v in jax.__version__.split(".")[:2])
+if not (_VALIDATED_JAX[0] <= _jax_ver <= _VALIDATED_JAX[1]) \
+        and os.environ.get("CXXNET_PP_VALIDATE") != "1":
+    # CXXNET_PP_VALIDATE=1 bypasses the gate so tools/validate_pp_jax.py
+    # can exercise the semantics on a candidate jax version — see
+    # doc/multichip.md "Re-validating pipeline parallelism"
     raise ImportError(
         f"cxxnet_tpu pipeline parallelism was validated on jax "
         f"{_VALIDATED_JAX[0][0]}.{_VALIDATED_JAX[0][1]}–"
@@ -110,14 +121,19 @@ def pipeline_apply_stages(stage_fns, params: Any, x: jax.Array, aux: Any,
                           stats_sd=None):
     """GPipe schedule over HETEROGENEOUS stages (the config-driven path).
 
-    ``stage_fns``: S callables. ``f_k(params, mb_input, m) -> (y, stats)``
-    — ``m`` is the microbatch index (fold it into any dropout rng so masks
-    differ per microbatch). ``f_0`` ingests raw data microbatches; middle
-    stages ingest the boundary activation; the LAST stage is
+    ``stage_fns``: S callables.
+    ``f_k(params, mb_input, m) -> (y, scalar, stats)`` — ``m`` is the
+    microbatch index (fold it into any dropout rng so masks differ per
+    microbatch). ``f_0`` ingests raw data microbatches; middle stages
+    ingest the boundary activation; the LAST stage is
     ``f_{S-1}(params, inp, aux_mb, m) -> (y, scalar, stats)`` — it also
     receives its microbatch's slice of ``aux`` (labels/mask, any pytree
-    with leading dim M) and returns the final output plus a per-microbatch
-    scalar (the loss). ``stats`` is a per-microbatch statistics pytree
+    with leading dim M). Every stage's per-microbatch ``scalar`` (loss
+    for the last stage; auxiliary losses like MoE load-balance terms for
+    body stages — return 0.0 when none) is summed over live ticks AND
+    DIFFERENTIATED: the backward seeds each stage's scalar output with
+    the loss cotangent, so auxiliary losses raised inside the body train
+    their layers exactly as in the unsharded step. ``stats`` is a per-microbatch statistics pytree
     (batch_norm moments) with the SAME structure from every stage
     (``stats_sd`` — shape/dtype structs; pad entries a stage doesn't own
     with zeros; pass ``{}``/None when no stage has stats). Returns
@@ -216,11 +232,11 @@ def pipeline_apply_stages(stage_fns, params: Any, x: jax.Array, aux: Any,
                 def run(reg_in):
                     # stage k holds a real microbatch only in this window;
                     # fill/drain ticks recompute a clipped microbatch whose
-                    # stats must not contaminate the accumulator
+                    # stats/scalars must not contaminate the accumulators
                     live_k = jnp.logical_and(t - k >= 0, t - k < M)
+                    gate = jnp.where(live_k, 1.0, 0.0)
 
                     def mask_stats(st):
-                        gate = jnp.where(live_k, 1.0, 0.0)
                         return jax.tree_util.tree_map(
                             lambda a: pvary(a * gate.astype(a.dtype)), st)
 
@@ -229,10 +245,10 @@ def pipeline_apply_stages(stage_fns, params: Any, x: jax.Array, aux: Any,
                         y, scalar, st = last_call(params, inp, aux_,
                                                   t - (S - 1))
                         return (zero_reg, y.astype(zero_out.dtype),
-                                pvary(scalar), mask_stats(st))
-                    y, st = stage_fns[k](params, inp, t - k)
+                                pvary(scalar * gate), mask_stats(st))
+                    y, scalar, st = stage_fns[k](params, inp, t - k)
                     return (y.astype(zero_reg.dtype), zero_out,
-                            pvary(jnp.zeros((), jnp.float32)),
+                            pvary(jnp.asarray(scalar, jnp.float32) * gate),
                             mask_stats(st))
                 return run
 
@@ -246,7 +262,9 @@ def pipeline_apply_stages(stage_fns, params: Any, x: jax.Array, aux: Any,
                     o, bank[None].astype(o.dtype),
                     (done_idx,) + (0,) * (o.ndim - 1)),
                 lambda o: o, out)
-            loss = loss + jnp.where(live, scalar, 0.0)
+            # each branch already gated its scalar by its own liveness;
+            # the pipe-axis psum below merges the per-stage contributions
+            loss = loss + scalar
             stats = jax.tree_util.tree_map(jnp.add, stats, st_t)
             reg_next = lax.ppermute(reg_new, axis_name, perm)
             return (reg_next, out, loss, stats), reg  # save tick-ENTRY reg
@@ -330,12 +348,18 @@ def pipeline_apply_stages(stage_fns, params: Any, x: jax.Array, aux: Any,
                         m = t - k
                         live = jnp.logical_and(m >= 0, m < M)
                         dy = jnp.where(live, pvary(dreg_in), 0)
+                        # the stage's scalar (auxiliary loss) joined the
+                        # loss accumulator on live ticks — seed it with
+                        # the same loss cotangent the last stage gets
+                        ds = jnp.where(live, dloss, 0.0)
                         _, vjp = jax.vjp(
-                            lambda pp, xx: stage_fns[k](pp, xx, m)[0].astype(
-                                dy.dtype),
+                            lambda pp, xx: (lambda r: (
+                                r[0].astype(dy.dtype),
+                                jnp.asarray(r[1], jnp.float32)))(
+                                    stage_fns[k](pp, xx, m)),
                             pv_params, inp.astype(
                                 xs.dtype if k == 0 else boundary_sd.dtype))
-                        dp, dinp = vjp(dy)
+                        dp, dinp = vjp((dy, pvary(jnp.float32(ds))))
                     if k == 0:
                         return (dp, dinp.astype(zero_dx.dtype),
                                 pvary(zero_db))

@@ -207,18 +207,79 @@ class Trainer:
         # sp train/eval steps capture them with (data, seq) out-specs
 
     # -- model lifecycle ---------------------------------------------------
-    def _param_pspecs(self):
+    def _param_pspecs(self, params=None):
         """GSPMD placement specs for params. Under pipeline parallelism
-        the model axis is MANUAL inside the pp step (params enter
-        replicated and are sliced per shard in apply_stage), so host-side
-        model sharding is disabled there."""
-        return {} if self._pp > 1 else self.net.param_pspecs()
+        the model axis is MANUAL inside the pp step (apply_stage slices
+        planned weights per model shard), so 'model' sharding is disabled
+        there; instead params+optimizer state shard AT REST over the
+        'pipe' axis (FSDP-style: each leaf split on its first
+        pipe-divisible dim, all-gathered once at step entry, gradients
+        sliced back before the update). Per-device param+opt memory drops
+        ~pp-fold — the memory headroom pipelining exists to buy — at the
+        cost of one params all-gather per step, which for pp-scale models
+        is small next to a step's activation traffic."""
+        if self._pp > 1:
+            return (self._pp_fsdp_specs(params)
+                    if params is not None else {})
+        return self.net.param_pspecs()
+
+    def _pp_fsdp_specs(self, params):
+        """Per-leaf PartitionSpec tree: 'pipe' on the first dim divisible
+        by the pipe degree, P() (replicated) when no dim divides (odd
+        biases etc — a minority of bytes)."""
+        from jax.sharding import PartitionSpec as P
+        pp, pipe = self._pp, self.mesh.pipe_axis
+
+        def leaf_spec(x):
+            shape = np.shape(x)
+            for d, s in enumerate(shape):
+                if s and s % pp == 0:
+                    return P(*([None] * d + [pipe]))
+            return P()
+        return jax.tree_util.tree_map(leaf_spec, params)
+
+    @staticmethod
+    def _spec_dim(spec, axis_name):
+        for d, ax in enumerate(spec):
+            if ax == axis_name or (isinstance(ax, tuple) and axis_name in ax):
+                return d
+        return None
+
+    def _pp_gather_fn(self, specs):
+        """(inside the manual pp shard_map) rebuild full param leaves from
+        their pipe shards — one uniform all_gather per sharded leaf,
+        ordered before every ring op that consumes it."""
+        pipe = self.mesh.pipe_axis
+
+        def g(x, spec):
+            d = self._spec_dim(spec, pipe)
+            if d is None:
+                return x
+            return jax.lax.all_gather(x, pipe, axis=d, tiled=True)
+        return lambda tree: jax.tree_util.tree_map(
+            g, tree, specs, is_leaf=lambda v: v is None)
+
+    def _pp_scatter_fn(self, specs):
+        """(inside the manual pp shard_map) slice this pipe member's shard
+        out of a full (replicated-over-pipe) gradient leaf — collective-
+        free; the custom-vjp schedule already psum'd the grads."""
+        pipe = self.mesh.pipe_axis
+
+        def s(x, spec):
+            d = self._spec_dim(spec, pipe)
+            if d is None:
+                return x
+            n = x.shape[d] // self._pp
+            start = jax.lax.axis_index(pipe) * n
+            return jax.lax.dynamic_slice_in_dim(x, start, n, axis=d)
+        return lambda tree: jax.tree_util.tree_map(
+            s, tree, specs, is_leaf=lambda v: v is None)
 
     def _place(self, params, net_state=None, opt_state=None):
         """Shard params (TP specs from the layers; size-1 model axis =
-        replicated), mirror the sharding onto optimizer state, replicate
-        the small net state."""
-        pspecs = self._param_pspecs()
+        replicated; pipe-FSDP specs under pp), mirror the sharding onto
+        optimizer state, replicate the small net state."""
+        pspecs = self._param_pspecs(params)
         out = [self.mesh.shard_params(params, pspecs)]
         if net_state is not None:
             out.append(self.mesh.replicate(net_state))
@@ -231,7 +292,7 @@ class Trainer:
         if self.update_period > 1:
             self.accum = self.mesh.shard_params(
                 jax.tree_util.tree_map(jnp.zeros_like, params),
-                self._param_pspecs())
+                self._param_pspecs(params))
 
     def init_model(self) -> None:
         params, net_state = self.net.init(self._base_key)
@@ -483,6 +544,10 @@ class Trainer:
         out, st = jax.eval_shape(last, self.params, self.net_state, sd, lab,
                                  msk)
         stats.update(st)
+        # "_aux:<layer>" sink entries are per-stage scalar losses (moe) —
+        # they ride the schedule's differentiated scalar accumulator, not
+        # the stats structure
+        stats = {k: v for k, v in stats.items() if not k.startswith("_aux:")}
         strip = lambda a: jax.ShapeDtypeStruct(tuple(a.shape)[1:], a.dtype)
         return strip(boundary), strip(out), stats
 
@@ -513,15 +578,37 @@ class Trainer:
                     lambda a: jnp.zeros(a.shape, a.dtype), sub))
                 for name, sub in stats_sd.items()}
 
+        def split_aux(st):
+            """Separate per-stage scalar losses ("_aux:<layer>", moe) from
+            the batch-stat sink — scalars join the schedule's
+            differentiated loss accumulator."""
+            aux = jnp.zeros((), jnp.float32)
+            rest = {}
+            for k, v in st.items():
+                if k.startswith("_aux:"):
+                    aux = aux + v
+                else:
+                    rest[k] = v
+            return aux, rest
+
         def body(p, x, label, mask, rng, state):
             mb = x.shape[0] // M
             # fold the microbatch index into the rng so dropout masks are
             # independent across microbatches (they'd repeat otherwise)
+            def mid_fn(pp_, xx, m, _lo, _hi):
+                y, st = net.apply_stage(_lo, _hi, pp_, xx,
+                                        jax.random.fold_in(rng, m),
+                                        train, state, **tp_kw)
+                aux, st = split_aux(st)
+                # tie the scalar to the stage output so its JAX type is
+                # varying even for stages with no aux loss — a bare
+                # constant would type-mismatch the backward's varying
+                # cotangent seed; the 0-coefficient contributes nothing
+                aux = aux + 0.0 * y.ravel()[0].astype(jnp.float32)
+                return y, aux, pad_stats(st)
             fns = [
-                (lambda pp_, xx, m, _lo=lo, _hi=hi: (lambda y_st: (
-                    y_st[0], pad_stats(y_st[1])))(net.apply_stage(
-                        _lo, _hi, pp_, xx, jax.random.fold_in(rng, m),
-                        train, state, **tp_kw)))
+                (lambda pp_, xx, m, _lo=lo, _hi=hi: mid_fn(pp_, xx, m,
+                                                           _lo, _hi))
                 for lo, hi in ranges[:-1]]
             lo, hi = ranges[-1]
 
@@ -530,9 +617,10 @@ class Trainer:
                 rng_m = jax.random.fold_in(rng, m)
                 y, st = net.apply_stage(lo, hi, pp_, xx, rng_m, train, state,
                                         **tp_kw)
+                aux, st = split_aux(st)
                 res = net.apply_tail(n_body, pp_, {}, y, label_mb, mask_mb,
                                      rng_m, train)
-                return res.out, res.loss, pad_stats(st)
+                return res.out, res.loss + aux, pad_stats(st)
             fns.append(last_fn)
             aux = (label.reshape(M, mb, *label.shape[1:]),
                    mask.reshape(M, mb))
@@ -582,9 +670,22 @@ class Trainer:
         bn_ema = self._pp_bn_momenta()
         M = self._pp_microbatch
         rep = P()
+        # at-rest FSDP over 'pipe': sharded leaves enter as local shards,
+        # get all-gathered once up front, and the update runs on shards
+        pspecs = self._pp_fsdp_specs(self.params)
+        # state_pspecs marks replicated leaves None (shard_params' idiom);
+        # shard_map in_specs need an explicit P() there
+        opt_pspecs = jax.tree_util.tree_map(
+            lambda v: P() if v is None else v,
+            self.optimizer.state_pspecs(pspecs),
+            is_leaf=lambda v: v is None)
+        gather, scatter = self._pp_gather_fn(pspecs), \
+            self._pp_scatter_fn(pspecs)
 
         def step(params, opt_state, net_state, accum, data, label, mask,
                  rng, sched):
+            full = gather(params)
+
             def loss_fn(p):
                 top, loss, stats = pipeline(p, data, label, mask, rng,
                                             net_state)
@@ -596,7 +697,7 @@ class Trainer:
                 return jax.lax.pmean(loss, (data_axis, model_axis)), (top,
                                                                       stats)
             (loss, (out, stats)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
+                loss_fn, has_aux=True)(full)
             # manual-tp grad merge: psum over 'model' for EVERY leaf —
             # planned leaves hold partial (zero-padded slice) grads,
             # unplanned leaves hold 1/tp-scaled replicas; both sum to the
@@ -604,6 +705,10 @@ class Trainer:
             # Free when the model axis is size 1.
             grads = jax.tree_util.tree_map(
                 lambda v: jax.lax.psum(v, model_axis), grads)
+            # FSDP slice: the schedule's vjp left grads replicated over
+            # 'pipe'; take this member's shard so the optimizer runs on
+            # 1/pp of the state (collective-free)
+            grads = scatter(grads)
             # model peers compute identical outputs (activations are
             # all-gathered); pmean makes them invariant for the out_specs
             out = jax.lax.pmean(out, model_axis)
@@ -633,11 +738,13 @@ class Trainer:
 
         ds = P(data_axis, *([None] * (len(data_shape) - 1)))
         out_spec = P(data_axis, *([None] * len(out_sd.shape)))
+        accum_spec = pspecs if period > 1 else rep
         wrapped = jax.shard_map(
             step, mesh=self.mesh.mesh,
-            in_specs=(rep, rep, rep, rep, ds, P(data_axis), P(data_axis),
-                      rep, rep),
-            out_specs=(rep, rep, rep, rep, rep, out_spec, rep),
+            in_specs=(pspecs, opt_pspecs, rep, accum_spec, ds,
+                      P(data_axis), P(data_axis), rep, rep),
+            out_specs=(pspecs, opt_pspecs, rep, accum_spec, rep, out_spec,
+                       rep),
             axis_names={data_axis, pipe_axis, model_axis})
         return jax.jit(wrapped, donate_argnums=(0, 1, 2, 3))
 
@@ -646,19 +753,21 @@ class Trainer:
         data_axis, pipe_axis = self.mesh.data_axis, self.mesh.pipe_axis
         model_axis = self.mesh.model_axis
         pipeline, out_sd, _ = self._pp_pipeline_fn(data_shape, train=False)
+        pspecs = self._pp_fsdp_specs(self.params)
+        gather = self._pp_gather_fn(pspecs)
 
         def step(params, net_state, data):
             W = self.graph.label_width()
             label = jnp.zeros((data.shape[0], W), jnp.float32)
             mask = jnp.ones((data.shape[0],), jnp.float32)
-            top, _, _ = pipeline(params, data, label, mask,
+            top, _, _ = pipeline(gather(params), data, label, mask,
                                  jax.random.PRNGKey(0), net_state)
             return jax.lax.pmean(top, model_axis)
 
         ds = P(data_axis, *([None] * (len(data_shape) - 1)))
         out_spec = P(data_axis, *([None] * len(out_sd.shape)))
         wrapped = jax.shard_map(step, mesh=self.mesh.mesh,
-                                in_specs=(P(), P(), ds),
+                                in_specs=(pspecs, P(), ds),
                                 out_specs=out_spec,
                                 axis_names={data_axis, pipe_axis,
                                             model_axis})
@@ -688,6 +797,68 @@ class Trainer:
                     jax.random.fold_in(rng, 1))
 
         return jax.jit(step, donate_argnums=(0, 1, 2, 3))
+
+    def _make_chained_train_step(self, k: int):
+        """``k`` full train steps in ONE dispatch (lax.scan over the same
+        step body _make_train_step jits singly). Exists because per-step
+        dispatch over a remote-device link measures the link, not the chip
+        (the reference's per-batch Update never had this problem — its
+        driver sat on the PCIe bus): bench.py times a k-chain and divides.
+        Also usable for real training on a fixed accumulation window. The
+        batch is fixed across the k steps; rng chains per-step exactly as
+        ``update`` does."""
+        net, opt = self.net, self.optimizer
+
+        def step(params, opt_state, net_state, data, label, mask, extra,
+                 rng, sched):
+            def body(carry, _):
+                params, opt_state, net_state, rng = carry
+                def loss_fn(p):
+                    res = net.apply(p, net_state, data, label, mask,
+                                    extra_data=extra, rng=rng, train=True,
+                                    capture_nodes=False)
+                    return res.loss, res.state
+                (loss, new_state), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
+                params, opt_state = opt.update(params, grads, opt_state,
+                                               sched)
+                return (params, opt_state, new_state,
+                        jax.random.fold_in(rng, 1)), loss
+            (params, opt_state, net_state, rng), losses = jax.lax.scan(
+                body, (params, opt_state, net_state, rng), None, length=k)
+            return params, opt_state, net_state, losses, rng
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def update_chain(self, batch: DataBatch, k: int) -> "jax.Array":
+        """Run ``k`` train steps on one (fixed) batch in a single device
+        dispatch; returns the per-step loss vector (device array — fetch
+        to sync). Standard mode only: chained stepping composes with
+        dp/tp shardings but not with the pp/sp custom schedules, gradient
+        accumulation, or train-metric capture. LR/momentum schedules are
+        evaluated once at chain start and held for the k steps."""
+        assert self.params is not None, "call init_model() first"
+        if self._pp > 1 or self._sp > 1 or self.update_period > 1:
+            raise ValueError("update_chain: std mode only (no pp/sp/"
+                             "update_period)")
+        key = ("chain", k)
+        if key not in self._train_step_fns:
+            self._train_step_fns[key] = self._make_chained_train_step(k)
+        mask = self._mask(batch)
+        if self._rng_key is None:
+            self._rng_key = jax.random.fold_in(self._base_key,
+                                               self._step_count)
+        staged = self.stage_batch(batch)
+        (self.params, self.opt_state, self.net_state, losses,
+         self._rng_key) = self._train_step_fns[key](
+             self.params, self.opt_state, self.net_state, staged.data,
+             staged.label, mask, tuple(staged.extra_data), self._rng_key,
+             self._sched_scalars())
+        self._last_loss = losses[-1]
+        self._step_count += k
+        self.sample_counter = 0
+        self.epoch_counter += k
+        return losses
 
     def _sched_scalars(self):
         """Schedule values as traced device scalars (no recompile when they

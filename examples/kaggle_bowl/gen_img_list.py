@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""Build the train/test image list (reference parity:
+example/kaggle_bowl/gen_img_list.py): class indices come from the
+sampleSubmission.csv header order; train lists scan per-class folders,
+test lists scan one flat folder; output is the tab-separated
+``index<TAB>label<TAB>path`` .lst format tools/im2rec.py consumes.
+
+Usage: gen_img_list.py train|test sample_submission.csv image_folder img.lst
+"""
+
+import csv
+import os
+import random
+import sys
+
+
+def main(argv):
+    if len(argv) < 5:
+        print("Usage: gen_img_list.py train|test sample_submission.csv "
+              "image_folder img.lst")
+        return 1
+    random.seed(888)
+    task, sample_csv, folder, out_path = argv[1:5]
+    with open(sample_csv, newline="") as f:
+        classes = next(csv.reader(f))[1:]
+
+    img_lst = []
+    cnt = 0
+    if task == "train":
+        for label, cls in enumerate(classes):
+            cls_dir = os.path.join(folder, cls)
+            for img in sorted(os.listdir(cls_dir)):
+                img_lst.append((cnt, label, os.path.join(cls_dir, img)))
+                cnt += 1
+    else:
+        for img in sorted(os.listdir(folder)):
+            img_lst.append((cnt, 0, os.path.join(folder, img)))
+            cnt += 1
+
+    random.shuffle(img_lst)
+    with open(out_path, "w", newline="") as f:
+        fo = csv.writer(f, delimiter="\t", lineterminator="\n")
+        for item in img_lst:
+            fo.writerow(item)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""Resize raw NDSB images to 48x48 (reference parity: gen_train.py +
+gen_test.py, which shelled out to ImageMagick ``convert -resize 48x48!``;
+one script here since only the directory walk differed — ``train`` recurses
+per-class subfolders, ``test`` is a flat folder).
+
+Usage: gen_resize.py train|test input_folder output_folder [size]
+"""
+
+import os
+import sys
+
+from PIL import Image
+
+
+def resize_one(src, dst, size):
+    Image.open(src).convert("RGB").resize(
+        (size, size), Image.BILINEAR).save(dst)
+
+
+def main(argv):
+    if len(argv) < 4:
+        print("Usage: gen_resize.py train|test input_folder output_folder "
+              "[size]")
+        return 1
+    task, fi, fo = argv[1:4]
+    size = int(argv[4]) if len(argv) > 4 else 48
+    os.makedirs(fo, exist_ok=True)
+    if task == "train":
+        for cls in sorted(os.listdir(fi)):
+            src_dir = os.path.join(fi, cls)
+            if not os.path.isdir(src_dir):
+                continue
+            dst_dir = os.path.join(fo, cls)
+            os.makedirs(dst_dir, exist_ok=True)
+            for img in sorted(os.listdir(src_dir)):
+                resize_one(os.path.join(src_dir, img),
+                           os.path.join(dst_dir, img), size)
+    else:
+        for img in sorted(os.listdir(fi)):
+            src = os.path.join(fi, img)
+            if os.path.isfile(src):
+                resize_one(src, os.path.join(fo, img), size)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

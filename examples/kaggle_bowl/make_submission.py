@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""Assemble the Kaggle NDSB submission CSV (reference parity:
+example/kaggle_bowl/make_submission.py — same four inputs, same output):
+take the class-name header from sampleSubmission.csv, the image filenames
+from test.lst (tab-separated: index, label, path), and one row of softmax
+probabilities per image from the pred_raw output (test.txt), and write
+``image,prob_class0,...`` rows.
+
+Usage: python make_submission.py sample_submission.csv test.lst test.txt out.csv
+"""
+
+import csv
+import os
+import sys
+
+
+def main(argv):
+    if len(argv) < 5:
+        print("Usage: python make_submission.py sample_submission.csv "
+              "test.lst test.txt out.csv")
+        return 1
+    with open(argv[1], newline="") as f:
+        head = next(csv.reader(f))
+
+    img_lst = []
+    with open(argv[2], newline="") as f:
+        for line in csv.reader(f, delimiter="\t", lineterminator="\n"):
+            img_lst.append(os.path.basename(line[-1]))
+
+    with open(argv[3], newline="") as f_in, \
+            open(argv[4], "w", newline="") as f_out:
+        fo = csv.writer(f_out, lineterminator="\n")
+        fo.writerow(head)
+        n_class = len(head) - 1
+        for idx, line in enumerate(csv.reader(f_in, delimiter=" ",
+                                              lineterminator="\n")):
+            probs = [v for v in line if v != ""]
+            if len(probs) != n_class:
+                raise ValueError(
+                    f"row {idx}: {len(probs)} probabilities but the "
+                    f"submission header names {n_class} classes")
+            fo.writerow([img_lst[idx]] + probs)
+        if idx + 1 != len(img_lst):
+            raise ValueError(f"{len(img_lst)} images in {argv[2]} but "
+                             f"{idx + 1} prediction rows in {argv[3]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

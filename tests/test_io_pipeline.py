@@ -526,3 +526,17 @@ def test_conf_prefix_literal_percent_rejected():
         expand_conf_files("part%%d", "1-4", 0, 4)
     with pytest.raises(ValueError, match="does not vary"):
         expand_conf_files("part%.0s", "1-4", 0, 4)
+
+
+@pytest.mark.skipif((os.cpu_count() or 1) < 2,
+                    reason="decode-pool scaling needs >=2 host cores")
+def test_decode_pool_scales_with_threads():
+    """The GIL-released decode pool must actually parallelize: 2 threads
+    >= 1.6x of 1 thread on a multi-core host (VERDICT r3 ask #4)."""
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bench import decode_bench
+    res = decode_bench(image=64, n_img=96, threads=(1, 2))
+    ips = res["threads"]
+    assert ips[2] >= 1.6 * ips[1], f"decode pool not scaling: {ips}"

@@ -1,0 +1,183 @@
+"""The reference's documented kaggle_bowl loop, end to end on tiny data:
+gen_resize -> gen_img_list -> im2rec -> train -> pred_raw -> make_submission
+(reference example/kaggle_bowl/README.md steps 1-6). Validates the final
+submission CSV schema the way Kaggle would."""
+
+import csv
+import importlib.util
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+from PIL import Image
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BOWL = os.path.join(REPO, "examples", "kaggle_bowl")
+
+from cxxnet_tpu.config import parse_config_string
+from cxxnet_tpu.main import LearnTask
+
+CLASSES = ["amphipods", "copepods", "diatoms", "shrimp"]
+SIZE = 16
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(BOWL, name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bowl_workflow_end_to_end(tmp_path, mesh8):
+    rng = np.random.RandomState(0)
+    raw_train = tmp_path / "raw_train"
+    raw_test = tmp_path / "raw_test"
+    for ci, cls in enumerate(CLASSES):
+        d = raw_train / cls
+        d.mkdir(parents=True)
+        for i in range(6):
+            # class-colored 20x20 images so the net can actually learn
+            img = np.full((20, 20, 3), 40 + 50 * ci, np.uint8)
+            img += rng.randint(0, 20, img.shape).astype(np.uint8)
+            Image.fromarray(img).save(d / f"{cls}_{i}.jpg")
+    raw_test.mkdir()
+    for i in range(7):
+        ci = i % len(CLASSES)
+        img = np.full((20, 20, 3), 40 + 50 * ci, np.uint8)
+        img += rng.randint(0, 20, img.shape).astype(np.uint8)
+        Image.fromarray(img).save(raw_test / f"t{i}.jpg")
+
+    sample_csv = tmp_path / "sampleSubmission.csv"
+    with open(sample_csv, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["image"] + CLASSES)
+        w.writerow(["dummy.jpg"] + ["0.25"] * len(CLASSES))
+
+    # 1. resize (gen_train/gen_test analog)
+    gen_resize = _load("gen_resize")
+    assert gen_resize.main(["x", "train", str(raw_train),
+                            str(tmp_path / "train"), str(SIZE)]) == 0
+    assert gen_resize.main(["x", "test", str(raw_test),
+                            str(tmp_path / "test"), str(SIZE)]) == 0
+
+    # 2. image lists (class order = submission header order)
+    gen_img_list = _load("gen_img_list")
+    train_lst = tmp_path / "train.lst"
+    test_lst = tmp_path / "test.lst"
+    assert gen_img_list.main(["x", "train", str(sample_csv),
+                              str(tmp_path / "train"), str(train_lst)]) == 0
+    assert gen_img_list.main(["x", "test", str(sample_csv),
+                              str(tmp_path / "test"), str(test_lst)]) == 0
+    assert len(open(train_lst).readlines()) == 6 * len(CLASSES)
+
+    # 3. pack recordio
+    train_rec = tmp_path / "bowl_train.rec"
+    test_rec = tmp_path / "bowl_test.rec"
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    for lst, rec in ((train_lst, train_rec), (test_lst, test_rec)):
+        subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "im2rec.py"),
+             str(lst), "/", str(rec)], check=True, env=env)
+
+    # 4. train a shrunk bowl net (the real conf's augmentation + tag-scoped
+    # lr dialect, CI-sized net)
+    model_dir = tmp_path / "models"
+    model_dir.mkdir()
+    conf = f"""
+data = train
+iter = imgrec
+  image_rec = "{train_rec}"
+  divideby = 255
+  rand_mirror = 1
+  shuffle = 1
+iter = end
+
+netconfig = start
+layer[+1] = conv:cv1
+  kernel_size = 3
+  nchannel = 8
+  pad = 1
+layer[+1] = relu:ac1
+layer[+1] = max_pooling:mp1
+  kernel_size = 2
+  stride = 2
+layer[+1] = flatten:fl
+layer[+1] = fullc:fc2
+  nhidden = {len(CLASSES)}
+netconfig = end
+layer[+0] = softmax
+
+input_shape = 3,{SIZE},{SIZE}
+batch_size = 8
+dev = cpu
+num_round = 8
+save_period = 8
+momentum = 0.9
+wmat:lr = 0.02
+bias:lr = 0.04
+metric = error
+silent = 1
+model_dir = {model_dir}
+"""
+    # netconfig must close before stray layers — keep softmax inside
+    conf = conf.replace("netconfig = end\nlayer[+0] = softmax",
+                        "layer[+0] = softmax\nnetconfig = end")
+    LearnTask(parse_config_string(conf)).run()
+    model = model_dir / "0007.model"
+    assert model.exists()
+
+    # 5. pred_raw -> test.txt (pred.conf analog)
+    pred_txt = tmp_path / "test.txt"
+    pred_conf = f"""
+pred = {pred_txt}
+iter = imgrec
+  image_rec = "{test_rec}"
+  divideby = 255
+iter = end
+
+task = pred_raw
+model_in = {model}
+
+netconfig = start
+layer[+1] = conv:cv1
+  kernel_size = 3
+  nchannel = 8
+  pad = 1
+layer[+1] = relu:ac1
+layer[+1] = max_pooling:mp1
+  kernel_size = 2
+  stride = 2
+layer[+1] = flatten:fl
+layer[+1] = fullc:fc2
+  nhidden = {len(CLASSES)}
+layer[+0] = softmax
+netconfig = end
+
+input_shape = 3,{SIZE},{SIZE}
+batch_size = 8
+dev = cpu
+silent = 1
+"""
+    LearnTask(parse_config_string(pred_conf)).run()
+    rows = [l.split() for l in open(pred_txt).read().splitlines()]
+    assert len(rows) == 7                      # padding rows trimmed
+    assert all(len(r) == len(CLASSES) for r in rows)
+    for r in rows:
+        np.testing.assert_allclose(sum(map(float, r)), 1.0, atol=1e-3)
+
+    # 6. submission CSV
+    make_submission = _load("make_submission")
+    out_csv = tmp_path / "out.csv"
+    assert make_submission.main(["x", str(sample_csv), str(test_lst),
+                                 str(pred_txt), str(out_csv)]) == 0
+    with open(out_csv, newline="") as f:
+        got = list(csv.reader(f))
+    assert got[0] == ["image"] + CLASSES
+    assert len(got) == 1 + 7
+    names = {r[0] for r in got[1:]}
+    assert names == {f"t{i}.jpg" for i in range(7)}
+    for r in got[1:]:
+        np.testing.assert_allclose(sum(map(float, r[1:])), 1.0, atol=1e-3)

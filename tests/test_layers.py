@@ -411,3 +411,47 @@ layer[+1:h2] = share[fc1]""")
     h2 = h1 @ w + b
     np.testing.assert_allclose(np.asarray(res.out).reshape(2, 16), h2,
                                rtol=1e-4)
+
+
+@pytest.mark.parametrize("cin,hw,k,s,p", [
+    (3, 23, 11, 4, 0),   # AlexNet-stem geometry (shrunk spatially)
+    (3, 24, 7, 2, 3),    # ResNet-stem geometry
+    (1, 13, 5, 3, 2),    # uneven: kernel not a stride multiple, odd input
+    (4, 16, 4, 2, 1),    # kernel == 2*stride exactly
+    (3, 10, 3, 2, 0),    # floor mode drops tail rows
+])
+def test_conv_space_to_depth_matches_direct(cin, hw, k, s, p):
+    """The stem-conv space-to-depth lowering is an exact rewrite: compare
+    against the direct conv path (forward AND input gradient)."""
+    from cxxnet_tpu.layers.conv import ConvolutionLayer
+    body = (f"layer[0->1] = conv:cv\n  kernel_size = {k}\n  stride = {s}\n"
+            f"  pad = {p}\n  nchannel = 8")
+    net = make_net(body, input_shape=f"{cin},{hw},{hw}")
+    x = np.random.RandomState(11).randn(2, hw, hw, cin).astype(np.float32)
+    params, state = net.init(jax.random.PRNGKey(1))
+    cv = next(l for l in net.layers if getattr(l, "name", "") == "cv")
+    assert cv._use_space_to_depth()
+
+    def fwd(p, force_direct):
+        if force_direct:
+            orig = ConvolutionLayer._use_space_to_depth
+            ConvolutionLayer._use_space_to_depth = lambda self: False
+            try:
+                r = net.apply(p, state, jnp.asarray(x))
+            finally:
+                ConvolutionLayer._use_space_to_depth = orig
+        else:
+            r = net.apply(p, state, jnp.asarray(x))
+        return r.out
+
+    y_s2d = np.asarray(fwd(params, False))
+    y_dir = np.asarray(fwd(params, True))
+    assert y_s2d.shape == y_dir.shape
+    np.testing.assert_allclose(y_s2d, y_dir, rtol=1e-4, atol=1e-5)
+
+    g_s2d = jax.grad(lambda p: jnp.sum(jnp.square(fwd(p, False))))(params)
+    g_dir = jax.grad(lambda p: jnp.sum(jnp.square(fwd(p, True))))(params)
+    for tag in ("wmat", "bias"):
+        np.testing.assert_allclose(np.asarray(g_s2d["cv"][tag]),
+                                   np.asarray(g_dir["cv"][tag]),
+                                   rtol=1e-3, atol=1e-4)

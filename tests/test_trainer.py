@@ -423,3 +423,30 @@ def test_async_checkpoint_error_surfaces(tmp_path, mesh8):
     tr.save_model(str(tmp_path / "no_such_dir" / "x.model"))
     with pytest.raises(RuntimeError, match="async checkpoint"):
         tr.wait_saves()
+
+
+def test_update_chain_matches_updates(mesh8):
+    """k chained steps in one dispatch == k individual updates (same batch,
+    same rng chain, constant schedule)."""
+    import jax
+    tr1 = make_trainer(mesh8, "eval_train = 0")
+    tr2 = make_trainer(mesh8, "eval_train = 0")
+    batch = next(iter(synth_iter()))
+    losses = tr1.update_chain(batch, 3)
+    for _ in range(3):
+        tr2.update(batch)
+    assert losses.shape == (3,)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5),
+        tr1.params, tr2.params)
+    np.testing.assert_allclose(float(losses[-1]), tr2.last_loss,
+                               rtol=1e-4, atol=1e-6)
+    assert tr1.epoch_counter == tr2.epoch_counter
+
+
+def test_update_chain_refuses_special_modes(mesh8):
+    tr = make_trainer(mesh8, "eval_train = 0\nupdate_period = 2")
+    batch = next(iter(synth_iter()))
+    with pytest.raises(ValueError):
+        tr.update_chain(batch, 2)

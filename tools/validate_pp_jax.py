@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Re-validate pipeline parallelism on the installed jax version.
+
+The custom GPipe backward (cxxnet_tpu/parallel/pipeline.py) leans on
+varying-manual-axes semantics (lax.pcast/pvary + transpose behavior inside
+lax.switch under shard_map) that are version-sensitive in jax, so
+pipeline.py refuses to import outside its validated version range
+(`_VALIDATED_JAX`). A jax upgrade is then a 10-minute validation, not an
+archaeology project:
+
+    python tools/validate_pp_jax.py
+
+It sets CXXNET_PP_VALIDATE=1 (bypassing the version gate), runs every
+pipeline test in tests/test_parallel_ext.py on the virtual 8-device CPU
+mesh — exactness vs unsharded, BN stat merging, MoE aux-loss
+differentiation, pp x tp composition, FSDP at-rest sharding, rejection
+paths — and on success prints the one-line edit that widens
+_VALIDATED_JAX. See doc/multichip.md ("Re-validating pipeline
+parallelism").
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PP_TESTS = [
+    "tests/test_parallel_ext.py::test_config_driven_pipeline_matches_unsharded",
+    "tests/test_parallel_ext.py::test_pipeline_rejects_cross_stage_skip",
+    "tests/test_parallel_ext.py::test_pipeline_rejects_stateful_body",
+    "tests/test_parallel_ext.py::test_pipeline_bn_exact_match_single_microbatch",
+    "tests/test_parallel_ext.py::test_pipeline_bn_microbatched_trains_and_evals",
+    "tests/test_parallel_ext.py::test_pipeline_composes_with_tensor_parallel",
+    "tests/test_parallel_ext.py::test_pipeline_moe_lm_matches_unsharded",
+    "tests/test_parallel_ext.py::test_pp_params_shard_at_rest_over_pipe",
+]
+
+
+def main() -> int:
+    import jax
+    ver = jax.__version__
+    print(f"validating pipeline parallelism on jax {ver} ...")
+    env = {**os.environ, "CXXNET_PP_VALIDATE": "1", "JAX_PLATFORMS": "cpu"}
+    rc = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", *PP_TESTS],
+        cwd=REPO, env=env).returncode
+    if rc != 0:
+        print(f"\nFAILED on jax {ver}: the pvary/pcast semantics the "
+              "pipeline backward relies on have shifted. Do NOT widen "
+              "_VALIDATED_JAX; fix parallel/pipeline.py first "
+              "(start from its pvary() helper and run_bwd).")
+        return rc
+    minor = tuple(int(re.match(r"\d+", v).group())
+                  for v in ver.split(".")[:2])
+    print(f"\nOK on jax {ver}. To accept this version, widen the range in "
+          f"cxxnet_tpu/parallel/pipeline.py:\n"
+          f"    _VALIDATED_JAX = ((0, 9), {minor})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
