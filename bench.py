@@ -142,25 +142,51 @@ def compute_bench(tr, image, classes, batch, steps, ref_cost_fn=None):
     # observed). The FIRST probe call pays the scan's jit compile, which
     # would dwarf the step time and clamp K2 to its minimum — estimate
     # from a SECOND, post-compile call
-    probe_k = max(2, min(8, steps))
-    first_losses = tr.update_chain(b, probe_k)
-    loss_start = float(first_losses[0])
-    t0 = time.perf_counter()
-    float(tr.update_chain(b, probe_k)[-1])
-    est = (time.perf_counter() - t0) / probe_k
-    k2 = int(max(8, min(1200, 2.0 / max(est, 1e-5))))
-    k1 = max(2, k2 // 8)
-    # warm both chain lengths (compile + donation layout settle)
-    float(tr.update_chain(b, k1)[-1])
-    float(tr.update_chain(b, k2)[-1])
-    times = {k1: [], k2: []}
-    loss_end = None
-    for k in (k1, k2, k1, k2, k1, k2):
+    timing_method = "chained"
+    try:
+        probe_k = max(2, min(8, steps))
+        first_losses = tr.update_chain(b, probe_k)
+        loss_start = float(first_losses[0])
         t0 = time.perf_counter()
-        losses = tr.update_chain(b, k)
-        loss_end = float(losses[-1])         # value sync ends the timing
-        times[k].append(time.perf_counter() - t0)
-    dt_step = (min(times[k2]) - min(times[k1])) / (k2 - k1)
+        float(tr.update_chain(b, probe_k)[-1])
+        est = (time.perf_counter() - t0) / probe_k
+        k2 = int(max(8, min(1200, 2.0 / max(est, 1e-5))))
+        k1 = max(2, k2 // 8)
+        # warm both chain lengths (compile + donation layout settle)
+        float(tr.update_chain(b, k1)[-1])
+        float(tr.update_chain(b, k2)[-1])
+        times = {k1: [], k2: []}
+        loss_end = None
+        for k in (k1, k2, k1, k2, k1, k2):
+            t0 = time.perf_counter()
+            losses = tr.update_chain(b, k)
+            loss_end = float(losses[-1])     # value sync ends the timing
+            times[k].append(time.perf_counter() - t0)
+        dt_step = (min(times[k2]) - min(times[k1])) / (k2 - k1)
+        if dt_step <= 0:                     # jitter swamped a tiny model
+            raise RuntimeError(
+                f"non-positive slope ({dt_step:.2e}s) — link jitter "
+                f"exceeded the k2-k1 window")
+    except Exception as e:                   # pragma: no cover - HW path
+        # the bench must never die to a chained-dispatch issue on a new
+        # backend: fall back to per-dispatch wall timing (overstates step
+        # time by the link RTT — flagged in the output)
+        print(f"chained timing unavailable ({type(e).__name__}: {e}); "
+              f"falling back to per-dispatch wall timing", file=sys.stderr)
+        timing_method = f"per-dispatch wall fallback ({type(e).__name__})"
+        # re-init: a failed chain may have (a) consumed the donated
+        # param/opt buffers mid-execution and (b) already driven the
+        # fixed-batch loss to its floor, which would void the
+        # loss-decrease self-check below
+        tr.init_model()
+        tr.update(b)
+        tr.update(b)
+        loss_start = tr.last_loss
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            tr.update(b)
+        loss_end = float(tr._last_loss)      # value sync (see note above)
+        dt_step = (time.perf_counter() - t0) / steps
 
     assert loss_end < loss_start, (
         f"bench self-check failed: loss did not decrease over the timed "
@@ -231,6 +257,7 @@ def compute_bench(tr, image, classes, batch, steps, ref_cost_fn=None):
         "loss_end": loss_end,
         "n_chips": n_chips,
         "flops_normalized": flops_normalized,
+        "timing_method": timing_method,
     }
 
 
@@ -433,6 +460,7 @@ def main() -> None:
             # bowl (~0.02 TFLOP/step) under it in rounds 1-3
             "per_step_ms": round(mc["per_step_ms"], 3),
             "flops_normalized": mc["flops_normalized"],
+            "timing_method": mc["timing_method"],
             "loss_start": round(mc["loss_start"], 4),
             "loss_end": round(mc["loss_end"], 4),
             "learning": learning,
@@ -471,8 +499,10 @@ def main() -> None:
         "arith_intensity": round(c["arith_intensity"], 1),
         "step_tflop": round(c["step_tflop"], 4),
         "per_step_ms": round(c["per_step_ms"], 3),
-        "timing": "k-step chained dispatch, slope of two chain lengths "
-                  "(device time; cancels link RTT + one-off recompiles)",
+        "timing": ("k-step chained dispatch, slope of two chain lengths "
+                   "(device time; cancels link RTT + one-off recompiles)"
+                   if c["timing_method"] == "chained"
+                   else c["timing_method"]),
         "peak_bf16_tflops": c["peak_bf16_tflops"],
         "chip": jax.devices()[0].device_kind,
         "n_chips": c["n_chips"],
