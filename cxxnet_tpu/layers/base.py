@@ -135,6 +135,11 @@ class ApplyCtx:
     # shard_map — layers whose statistics must be global (MoE aux loss)
     # reduce over it too
     data_axis: Optional[str] = None
+    # pipeline stages set this with seq_axis: attention uses the gather-kv
+    # path (all_gather rendezvous is subgroup-scoped and safe inside a
+    # lax.switch branch) instead of the ring (collective_permute's global
+    # rendezvous deadlocks when other stages never reach it)
+    seq_gather_kv: bool = False
     # bound inside the pipeline-parallel schedule (train only): layers with
     # batch statistics (batch_norm) record raw microbatch moments here
     # instead of updating running state — the schedule accumulates them

@@ -215,6 +215,12 @@ class MultiHeadAttentionLayer(Layer, _SeqLinearMixin):
 
     def _attend(self, q, k, v, ctx):
         if ctx.seq_axis is not None:
+            if ctx.seq_gather_kv:
+                # pipeline-parallel stage: one k/v all-gather (safe inside
+                # the stage's switch branch) instead of the ring
+                from ..ops.attention import gather_kv_attention
+                return gather_kv_attention(q, k, v, axis_name=ctx.seq_axis,
+                                           causal=self.causal)
             # sequence-parallel step (shard_map): q/k/v are local sequence
             # shards; the ring carries k/v around the mesh axis
             from ..parallel.ring import ring_attention

@@ -187,12 +187,12 @@ class Network:
         from per-layer ``stage = k`` config annotations (a layer without
         one inherits the previous layer's stage). Loss layers are excluded
         from the pipeline body — they run on the reassembled full batch.
-        Validates: stages non-decreasing and covering 0..S-1, no
-        cross-stage skip connections (every input of a stage-k layer is
-        produced in stage k, or is the single boundary node from stage
-        k-1), identical boundary activation shapes, and no stateful layers
-        in the body (BN running stats / MoE aux-loss don't commute with
-        the microbatch schedule)."""
+        Validates: stages non-decreasing and covering 0..S-1, no reads
+        from later stages, and no stateful layers in the body beyond
+        batch_norm/moe (whose moments/aux-loss ride the schedule's
+        sinks). Cross-stage skips and heterogeneous boundary shapes are
+        fine: each boundary's carried node set (``self._stage_carried``)
+        flat-packs into one ring register (trainer pack/unpack)."""
         g = self.graph
         n_body = len(g.layers)
         while n_body and self.layers[n_body - 1].is_loss:
@@ -232,10 +232,8 @@ class Network:
         node_stage = {0: 0}
         for i in range(g.extra_data_num):
             node_stage[1 + i] = 0
-        boundary_nodes = []
+        last_consumer: Dict[int, int] = {}
         for s, (lo, hi) in enumerate(ranges):
-            boundary = g.layers[lo - 1].nindex_out[0] if s > 0 else None
-            boundary_nodes.append(boundary)
             for li in range(lo, hi):
                 layer, spec = self.layers[li], g.layers[li]
                 if ((layer.has_state or layer.init_state(
@@ -258,21 +256,34 @@ class Network:
                         raise ValueError(
                             f"layer {spec.name!r}: input node produced in "
                             "a later stage")
-                    if src != s and not (src == s - 1 and ni == boundary):
-                        raise ValueError(
-                            f"pipeline_parallel: layer {spec.name!r} in "
-                            f"stage {s} reads a node from stage {src} that "
-                            "is not the stage boundary — cross-stage skip "
-                            "connections are not pipelinable")
+                    # cross-stage reads are fine: every node produced in
+                    # stages <= i and consumed after i rides the flat ring
+                    # register (see stage_carried / _pp_pipeline_fn pack)
+                    last_consumer[ni] = max(last_consumer.get(ni, -1), s)
                 for ni in spec.nindex_out:
-                    node_stage[ni] = s
-        # boundary shapes must be uniform (they share one ring register)
-        shapes = {self.node_shapes[g.layers[hi - 1].nindex_out[0]]
-                  for _, hi in ranges[:-1]}
-        if len(shapes) > 1:
-            raise ValueError(
-                f"pipeline_parallel: stage boundary shapes differ {shapes};"
-                " all boundaries share one ppermute register")
+                    # FIRST production stage: an in-place (layer[+0])
+                    # rewrite in a later stage must not hide the node from
+                    # earlier boundaries — the pre-rewrite value still has
+                    # to ride the register to reach that stage (pack reads
+                    # the stage-local node map, so each boundary carries
+                    # the latest value at its cut)
+                    node_stage.setdefault(ni, s)
+        # carried set per boundary i: nodes produced in stages <= i still
+        # needed after i — the final body node is "consumed" by the loss
+        # tail, so it is carried to the end. Boundary shapes/counts may
+        # differ per cut: the trainer packs each boundary's carried nodes
+        # into one flat max-size ring register (_pp_pipeline_fn pack).
+        top_node = g.layers[n_body - 1].nindex_out[0]
+        last_consumer[top_node] = len(ranges)
+        self._stage_carried = [
+            sorted(ni for ni, s_prod in node_stage.items()
+                   if s_prod <= i and last_consumer.get(ni, -1) > i)
+            for i in range(len(ranges) - 1)]
+        for i, carried in enumerate(self._stage_carried):
+            if not carried:
+                raise ValueError(
+                    f"pipeline boundary {i} carries no nodes — stage "
+                    f"{i + 1} reads nothing from earlier stages")
         return ranges
 
     def tp_manual_plan(self, tp_size: int) -> Dict[str, Dict[str, int]]:
@@ -333,33 +344,44 @@ class Network:
                   f"(tp={tp_size}) — {detail}")
         return plan
 
-    def apply_stage(self, lo: int, hi: int, params: Params, x: jax.Array,
+    def apply_stage(self, lo: int, hi: int, params: Params, seed,
                     rng: jax.Array, train: bool,
                     state: Optional[NetState] = None,
                     tp_axis: Optional[str] = None,
                     tp_size: int = 1,
-                    tp_plan: Optional[Dict[str, Dict[str, int]]] = None
-                    ) -> Tuple[jax.Array, Dict[str, Any]]:
-        """Run layers [lo, hi) on one microbatch: ``x`` is the raw data
-        (lo == 0) or the boundary activation. Returns ``(out, stats)``:
-        the range's final node value plus the raw microbatch moments of
-        any batch-stat layers (batch_norm) in the range — train only; the
-        pipeline schedule accumulates these and the trainer applies one
-        exact full-batch running-stat update after the ring. ``state`` is
-        read-only (eval-time BN running stats); never mutated."""
+                    tp_plan: Optional[Dict[str, Dict[str, int]]] = None,
+                    want: Optional[List[int]] = None,
+                    seq_axis: Optional[str] = None,
+                    data_axis: Optional[str] = None):
+        """Run layers [lo, hi) on one microbatch. ``seed`` is the raw data
+        array (lo == 0) or a {node_index: value} dict of carried nodes
+        (stage_carried). Returns ``(out, stats)`` where ``out`` is the
+        range's final node value, or {node_index: value} for the nodes in
+        ``want`` when given (the carried set of the next boundary —
+        cross-stage skips ride along). ``stats``: raw microbatch moments
+        of any batch-stat layers (batch_norm) in the range — train only;
+        the pipeline schedule accumulates these and the trainer applies
+        one exact full-batch running-stat update after the ring.
+        ``state`` is read-only (eval-time BN running stats)."""
         g = self.graph
         nodes: Dict[int, jax.Array] = {}
-        if lo == 0:
-            nodes[0] = x
+        if isinstance(seed, dict):
+            nodes.update(seed)
         else:
-            nodes[g.layers[lo - 1].nindex_out[0]] = x
+            nodes[0] = seed
         sink: Dict[str, Any] = {}
         tp_plan = tp_plan or {}
         for li in range(lo, hi):
             spec, layer = g.layers[li], self.layers[li]
+            # seq/data axes bound under the sequence-parallel pipeline:
+            # mha takes the ring path, moe routes globally — collectives
+            # scoped to this stage's seq/data peers, which all execute
+            # the same switch branch
             ctx = ApplyCtx(train=train, rng=jax.random.fold_in(rng, li),
                            compute_dtype=self.compute_dtype,
-                           stat_sink=sink if train else None)
+                           stat_sink=sink if train else None,
+                           seq_axis=seq_axis, data_axis=data_axis,
+                           seq_gather_kv=seq_axis is not None)
             inputs = [nodes[ni] for ni in spec.nindex_in]
             lstate = (state or {}).get(layer.name, {})
             lparams = params.get(layer.name, {})
@@ -383,15 +405,24 @@ class Network:
                                               axis=ax, tiled=True)]
             for ni, out in zip(spec.nindex_out, outputs):
                 nodes[ni] = out
+        if want is not None:
+            return {ni: nodes[ni] for ni in want}, sink
         return nodes[g.layers[hi - 1].nindex_out[0]], sink
 
     def apply_tail(self, body_hi: int, params: Params, state: NetState,
                    top: jax.Array, label: Optional[jax.Array],
                    mask: jax.Array, rng: jax.Array,
-                   train: bool) -> ForwardResult:
+                   train: bool,
+                   label_slices: Optional[Dict[Tuple[int, int],
+                                               jax.Array]] = None,
+                   seq_axis: Optional[str] = None,
+                   data_axis: Optional[str] = None) -> ForwardResult:
         """Run the loss layers [body_hi, end) on the full-batch pipeline
         output ``top`` (they are row-wise, so GSPMD batch sharding
-        applies)."""
+        applies). ``label_slices``/``seq_axis``/``data_axis`` mirror
+        ``apply`` for the sequence-parallel pipeline: pre-sliced
+        width-sharded labels, and manual axes bound in the loss layers'
+        ctx."""
         g = self.graph
         nodes: Dict[int, jax.Array] = {
             g.layers[body_hi - 1].nindex_out[0]: top}
@@ -400,7 +431,8 @@ class Network:
         for li in range(body_hi, len(g.layers)):
             spec, layer = g.layers[li], self.layers[li]
             ctx = ApplyCtx(train=train, rng=jax.random.fold_in(rng, li),
-                           compute_dtype=self.compute_dtype)
+                           compute_dtype=self.compute_dtype,
+                           seq_axis=seq_axis, data_axis=data_axis)
             inputs = [nodes[ni] for ni in spec.nindex_in]
             outputs, lstate_out = layer.apply(
                 params.get(layer.name, {}), new_state.get(layer.name, {}),
@@ -409,10 +441,13 @@ class Network:
                 new_state[layer.name] = lstate_out
             for ni, out in zip(spec.nindex_out, outputs):
                 nodes[ni] = out
-            if layer.is_loss and label is not None:
+            if layer.is_loss and (label is not None
+                                  or label_slices is not None):
                 a, b = g.label_slice(layer.target)
+                lab = (label_slices[(a, b)] if label_slices is not None
+                       else label[:, a:b])
                 total_loss = total_loss + layer.loss(
-                    outputs, label[:, a:b].astype(jnp.float32), mask)
+                    outputs, lab.astype(jnp.float32), mask)
         out = nodes[g.layers[-1].nindex_out[0]]
         return ForwardResult(loss=total_loss, state=new_state, nodes=None,
                              out=out)

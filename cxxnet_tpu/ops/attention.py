@@ -78,6 +78,32 @@ def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
     return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(p.dtype)).astype(q.dtype)
 
 
+def gather_kv_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        axis_name: str, causal: bool = False,
+                        scale: Optional[float] = None) -> jax.Array:
+    """Sequence-parallel attention via ONE k/v all-gather per projection:
+    local q shard attends over the gathered global k/v with global-position
+    causal masking. Numerically identical to ring_attention; exists for the
+    pipeline-parallel composition, where the ring's collective_permute is
+    unsafe inside a stage's switch branch (its rendezvous is global across
+    the mesh on the CPU runtime — devices in other stages never arrive)
+    while all_gather participation is subgroup-scoped. Costs O(S_global)
+    k/v bytes per shard instead of the ring's O(S_local) residency.
+    q,k,v: (B, S_local, H, D) -> (B, S_local, H, D)."""
+    kg = lax.all_gather(k, axis_name, axis=1, tiled=True)
+    vg = lax.all_gather(v, axis_name, axis=1, tiled=True)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, kg,
+                   preferred_element_type=jnp.float32) * _scale(q, scale)
+    if causal:
+        off = lax.axis_index(axis_name) * q.shape[1]
+        qi = lax.broadcasted_iota(jnp.int32, s.shape, 2) + off
+        ki = lax.broadcasted_iota(jnp.int32, s.shape, 3)
+        s = jnp.where(qi >= ki, s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p,
+                      vg.astype(p.dtype)).astype(q.dtype)
+
+
 def _online_block_update(acc, m, l, q, kb, vb, q_pos, k_pos, scale, causal,
                          k_valid_upto=None):
     """One online-softmax accumulation step against key/value block (kb, vb).

@@ -144,8 +144,13 @@ class Trainer:
         self._pp_ranges = None
         if self._pp > 1:
             if self._sp > 1:
-                raise ValueError(
-                    "pipeline_parallel with seq_parallel is not supported")
+                # pp x sp: stages run ring attention / global MoE routing
+                # over the 'seq' axis INSIDE the pipe schedule — legal for
+                # the same reason manual tp is (a device's seq peers share
+                # its pipe coordinate, so every seq collective is executed
+                # by peers taking the same switch branch). Sequence nets
+                # only, like plain sp.
+                self._check_seq_parallel_ok()
             # model_parallel composes via MANUAL tensor parallelism:
             # apply_stage slices fullc/conv weights per model shard and
             # all-gathers outputs (Network.tp_manual_plan). GSPMD-auto
@@ -519,37 +524,60 @@ class Trainer:
         batch_norm moment structure (train only; empty at eval)."""
         mb = data_shape[0] // self.mesh.data_parallel // self._pp_microbatch
         rng0 = jax.random.PRNGKey(0)
-        W = self.graph.label_width()
-        sd = jax.ShapeDtypeStruct((mb,) + tuple(data_shape[1:]), jnp.float32)
-        boundary = None
+        sp = self._sp
+        carried = self.net._stage_carried
+        # local microbatch geometry: rows / (dp * M); the trailing token
+        # dim / sp under the sequence-parallel pipeline. Axes are NOT
+        # bound during the probe (eval_shape runs outside shard_map);
+        # local/global layer variants have identical local output shapes.
+        local = list(data_shape[1:])
+        if sp > 1:
+            local[-1] //= sp
+        seed = jax.ShapeDtypeStruct((mb,) + tuple(local), jnp.float32)
+        boundaries = []        # per boundary i: {node_index: sd} (with mb)
         stats: Dict[str, Any] = {}
-        for lo, hi in self._pp_ranges[:-1]:
-            sd, st = jax.eval_shape(
-                lambda p, s, x, _lo=lo, _hi=hi: self.net.apply_stage(
-                    _lo, _hi, p, x, rng0, train, s),
-                self.params, self.net_state, sd)
+        for k, (lo, hi) in enumerate(self._pp_ranges[:-1]):
+            seed, st = jax.eval_shape(
+                lambda p, s, x, _lo=lo, _hi=hi, _w=tuple(carried[k]):
+                    self.net.apply_stage(_lo, _hi, p, x, rng0, train, s,
+                                         want=list(_w)),
+                self.params, self.net_state, seed)
             stats.update(st)
-            if boundary is None:
-                boundary = sd
+            boundaries.append(seed)
         lo, hi = self._pp_ranges[-1]
         n_body = hi
-        lab = jax.ShapeDtypeStruct((mb, W), jnp.float32)
-        msk = jax.ShapeDtypeStruct((mb,), jnp.float32)
 
-        def last(p, s, x, label, mask):
-            y, st = self.net.apply_stage(lo, hi, p, x, rng0, train, s)
-            res = self.net.apply_tail(n_body, p, {}, y, label, mask, rng0,
-                                      train)
-            return res.out, st
-        out, st = jax.eval_shape(last, self.params, self.net_state, sd, lab,
-                                 msk)
+        msk = jax.ShapeDtypeStruct((mb,), jnp.float32)
+        if sp > 1:
+            lab = {(a, b): jax.ShapeDtypeStruct((mb, (b - a) // sp),
+                                                jnp.float32)
+                   for a, b in self.graph.label_range}
+
+            def last(p, s, x, lslices, mask):
+                y, st = self.net.apply_stage(lo, hi, p, x, rng0, train, s)
+                res = self.net.apply_tail(n_body, p, {}, y, None, mask,
+                                          rng0, train,
+                                          label_slices=lslices)
+                return res.out, st
+        else:
+            lab = jax.ShapeDtypeStruct((mb, self.graph.label_width()),
+                                       jnp.float32)
+
+            def last(p, s, x, label, mask):
+                y, st = self.net.apply_stage(lo, hi, p, x, rng0, train, s)
+                res = self.net.apply_tail(n_body, p, {}, y, label, mask,
+                                          rng0, train)
+                return res.out, st
+        out, st = jax.eval_shape(last, self.params, self.net_state, seed,
+                                 lab, msk)
         stats.update(st)
         # "_aux:<layer>" sink entries are per-stage scalar losses (moe) —
         # they ride the schedule's differentiated scalar accumulator, not
         # the stats structure
         stats = {k: v for k, v in stats.items() if not k.startswith("_aux:")}
         strip = lambda a: jax.ShapeDtypeStruct(tuple(a.shape)[1:], a.dtype)
-        return strip(boundary), strip(out), stats
+        return ([{ni: strip(sd) for ni, sd in b.items()}
+                 for b in boundaries], strip(out), stats)
 
     def _pp_pipeline_fn(self, data_shape, train: bool):
         """Local GPipe body (runs under shard_map): the stage schedule over
@@ -562,13 +590,53 @@ class Trainer:
         from .parallel.pipeline import pipeline_apply_stages
         net, ranges = self.net, self._pp_ranges
         n_body = ranges[-1][1]
-        boundary_sd, out_sd, stats_sd = self._pp_probe_shapes(data_shape,
-                                                              train)
+        boundary_sds, out_sd, stats_sd = self._pp_probe_shapes(data_shape,
+                                                               train)
+        # HETEROGENEOUS boundaries ride one flat max-size ring register:
+        # each stage packs its boundary's CARRIED node set (every node
+        # produced at or before the cut and consumed after it — so
+        # cross-stage skip connections simply ride along) as flattened
+        # concatenated segments, zero-padded to the max boundary size F;
+        # the next stage unpacks its own carried dict. The ppermute
+        # register stays uniform without constraining where stages may
+        # cut. Register dtype: the common result type of every carried
+        # node (f32 promotions are lossless; pad waste per boundary is
+        # (F - sum(prod(shape)))/F of the ring bytes).
+        carried = self.net._stage_carried
+        all_sds = [sd for b in boundary_sds for sd in b.values()]
+        reg_dtype = jnp.result_type(*[sd.dtype for sd in all_sds])
+        flat_n = max(sum(int(np.prod(sd.shape)) for sd in b.values())
+                     for b in boundary_sds)
+        boundary_sd = jax.ShapeDtypeStruct((flat_n,), reg_dtype)
+
+        def pack(i, nd):
+            parts = [nd[ni].reshape(nd[ni].shape[0], -1).astype(reg_dtype)
+                     for ni in carried[i]]
+            flat = jnp.concatenate(parts, axis=1) if len(parts) > 1 \
+                else parts[0]
+            pad = flat_n - flat.shape[1]
+            return jnp.pad(flat, ((0, 0), (0, pad))) if pad else flat
+
+        def unpack(reg, i):
+            out, off = {}, 0
+            for ni in carried[i]:
+                sd = boundary_sds[i][ni]
+                n = int(np.prod(sd.shape))
+                out[ni] = reg[:, off:off + n].reshape(
+                    reg.shape[0], *sd.shape).astype(sd.dtype)
+                off += n
+            return out
         pipe_axis, data_axis = self.mesh.pipe_axis, self.mesh.data_axis
         model_axis, tp = self.mesh.model_axis, self.mesh.model_parallel
         tp_plan = net.tp_manual_plan(tp)
         tp_kw = dict(tp_axis=model_axis, tp_size=tp, tp_plan=tp_plan)
         M = self._pp_microbatch
+        sp = self._sp
+        seq_axis = self.mesh.seq_axis if sp > 1 else None
+        label_ranges = list(self.graph.label_range)
+        if sp > 1:
+            # ring attention / global MoE routing inside the stages
+            tp_kw = dict(tp_kw, seq_axis=seq_axis, data_axis=data_axis)
 
         def pad_stats(st):
             # every stage must return the SAME stats structure through the
@@ -593,41 +661,69 @@ class Trainer:
 
         def body(p, x, label, mask, rng, state):
             mb = x.shape[0] // M
-            # fold the microbatch index into the rng so dropout masks are
-            # independent across microbatches (they'd repeat otherwise)
-            def mid_fn(pp_, xx, m, _lo, _hi):
-                y, st = net.apply_stage(_lo, _hi, pp_, xx,
-                                        jax.random.fold_in(rng, m),
-                                        train, state, **tp_kw)
+            # decorrelate dropout across data (and seq) shards, exactly as
+            # the sp step does — a replicated key would repeat masks on
+            # every shard's distinct rows/tokens. Model peers keep the
+            # SAME key: they compute replicas/slices of identical rows and
+            # divergent masks would break the manual-tp all-gather math.
+            rng = jax.random.fold_in(rng,
+                                     jax.lax.axis_index(data_axis))
+            if sp > 1:
+                rng = jax.random.fold_in(rng,
+                                         jax.lax.axis_index(seq_axis))
+            # the microbatch index folds in per microbatch below so masks
+            # are independent across microbatches too
+            def mid_fn(pp_, xx, m, k, _lo, _hi):
+                seed = xx if k == 0 else unpack(xx, k - 1)
+                nd, st = net.apply_stage(_lo, _hi, pp_, seed,
+                                         jax.random.fold_in(rng, m),
+                                         train, state,
+                                         want=list(carried[k]), **tp_kw)
                 aux, st = split_aux(st)
-                # tie the scalar to the stage output so its JAX type is
+                # tie the scalar to a stage output so its JAX type is
                 # varying even for stages with no aux loss — a bare
                 # constant would type-mismatch the backward's varying
                 # cotangent seed; the 0-coefficient contributes nothing
-                aux = aux + 0.0 * y.ravel()[0].astype(jnp.float32)
-                return y, aux, pad_stats(st)
+                first = nd[carried[k][0]]
+                aux = aux + 0.0 * first.ravel()[0].astype(jnp.float32)
+                return pack(k, nd), aux, pad_stats(st)
             fns = [
-                (lambda pp_, xx, m, _lo=lo, _hi=hi: mid_fn(pp_, xx, m,
-                                                           _lo, _hi))
-                for lo, hi in ranges[:-1]]
+                (lambda pp_, xx, m, _k=k, _lo=lo, _hi=hi: mid_fn(
+                    pp_, xx, m, _k, _lo, _hi))
+                for k, (lo, hi) in enumerate(ranges[:-1])]
             lo, hi = ranges[-1]
+
+            last_k = len(ranges) - 1
 
             def last_fn(pp_, xx, aux_mb, m):
                 label_mb, mask_mb = aux_mb
                 rng_m = jax.random.fold_in(rng, m)
-                y, st = net.apply_stage(lo, hi, pp_, xx, rng_m, train, state,
-                                        **tp_kw)
+                y, st = net.apply_stage(lo, hi, pp_, unpack(xx, last_k - 1),
+                                        rng_m, train, state, **tp_kw)
                 aux, st = split_aux(st)
-                res = net.apply_tail(n_body, pp_, {}, y, label_mb, mask_mb,
-                                     rng_m, train)
+                if sp > 1:
+                    res = net.apply_tail(
+                        n_body, pp_, {}, y, None, mask_mb, rng_m, train,
+                        label_slices=dict(zip(label_ranges, label_mb)),
+                        seq_axis=seq_axis, data_axis=data_axis)
+                else:
+                    res = net.apply_tail(n_body, pp_, {}, y, label_mb,
+                                         mask_mb, rng_m, train)
                 return res.out, res.loss + aux, pad_stats(st)
             fns.append(last_fn)
-            aux = (label.reshape(M, mb, *label.shape[1:]),
+            # label: one (rows, W) array, or under sp a tuple of
+            # width-sharded label_vec slices — reshape each leaf to
+            # (M, mb, ...) for per-microbatch delivery
+            aux = (jax.tree_util.tree_map(
+                       lambda a: a.reshape(M, mb, *a.shape[1:]), label),
                    mask.reshape(M, mb))
+            vary = (data_axis, model_axis) + ((seq_axis,) if sp > 1
+                                              else ())
             top, loss_sum, stats = pipeline_apply_stages(
                 fns, p, x, aux, pipe_axis, M, boundary_sd, out_sd,
-                extra_vary_axes=(data_axis, model_axis),
-                grad_sum_axes=(data_axis,),
+                extra_vary_axes=vary,
+                grad_sum_axes=(data_axis,) + ((seq_axis,) if sp > 1
+                                              else ()),
                 stats_sd=stats_sd)
             # each microbatch loss is a mean over its mb rows -> average
             # the M of them to match the non-pipelined per-batch loss
@@ -665,6 +761,9 @@ class Trainer:
         net, opt, period = self.net, self.optimizer, self.update_period
         pipe_axis, data_axis = self.mesh.pipe_axis, self.mesh.data_axis
         model_axis = self.mesh.model_axis
+        sp, seq_axis = self._sp, self.mesh.seq_axis
+        mean_axes = (data_axis, model_axis) + ((seq_axis,) if sp > 1
+                                               else ())
         pipeline, out_sd, tp_plan = self._pp_pipeline_fn(data_shape,
                                                          train=True)
         bn_ema = self._pp_bn_momenta()
@@ -693,9 +792,9 @@ class Trainer:
                 # seeds 1/tp per model peer, so the per-peer cotangent
                 # contributions (routed through the manual all-gather
                 # transposes) sum to exactly the true gradient — the same
-                # seed/psum pairing the data axis uses
-                return jax.lax.pmean(loss, (data_axis, model_axis)), (top,
-                                                                      stats)
+                # seed/psum pairing the data axis uses (and the seq axis
+                # under the sequence-parallel pipeline)
+                return jax.lax.pmean(loss, mean_axes), (top, stats)
             (loss, (out, stats)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(full)
             # manual-tp grad merge: psum over 'model' for EVERY leaf —
@@ -736,41 +835,64 @@ class Trainer:
             return (params, opt_state, new_state, accum, loss, out,
                     jax.random.fold_in(rng, 1))
 
-        ds = P(data_axis, *([None] * (len(data_shape) - 1)))
-        out_spec = P(data_axis, *([None] * len(out_sd.shape)))
+        if sp > 1:
+            ds = P(data_axis, *([None] * (len(data_shape) - 2)), seq_axis)
+            lspec = tuple(P(data_axis, seq_axis)
+                          for _ in self.graph.label_range)
+            out_spec = P(data_axis, seq_axis,
+                         *([None] * (len(out_sd.shape) - 1)))
+            axes = {data_axis, pipe_axis, model_axis, seq_axis}
+        else:
+            ds = P(data_axis, *([None] * (len(data_shape) - 1)))
+            lspec = P(data_axis)
+            out_spec = P(data_axis, *([None] * len(out_sd.shape)))
+            axes = {data_axis, pipe_axis, model_axis}
         accum_spec = pspecs if period > 1 else rep
         wrapped = jax.shard_map(
             step, mesh=self.mesh.mesh,
             in_specs=(pspecs, opt_pspecs, rep, accum_spec, ds,
-                      P(data_axis), P(data_axis), rep, rep),
+                      lspec, P(data_axis), rep, rep),
             out_specs=(pspecs, opt_pspecs, rep, accum_spec, rep, out_spec,
                        rep),
-            axis_names={data_axis, pipe_axis, model_axis})
+            axis_names=axes)
         return jax.jit(wrapped, donate_argnums=(0, 1, 2, 3))
 
     def _make_pp_eval_step(self, data_shape):
         from jax.sharding import PartitionSpec as P
         data_axis, pipe_axis = self.mesh.data_axis, self.mesh.pipe_axis
         model_axis = self.mesh.model_axis
+        sp, seq_axis = self._sp, self.mesh.seq_axis
         pipeline, out_sd, _ = self._pp_pipeline_fn(data_shape, train=False)
         pspecs = self._pp_fsdp_specs(self.params)
         gather = self._pp_gather_fn(pspecs)
+        label_ranges = list(self.graph.label_range)
 
         def step(params, net_state, data):
-            W = self.graph.label_width()
-            label = jnp.zeros((data.shape[0], W), jnp.float32)
-            mask = jnp.ones((data.shape[0],), jnp.float32)
+            rows = data.shape[0]
+            if sp > 1:           # local zero slices per label_vec range
+                label = tuple(jnp.zeros((rows, (b - a) // sp), jnp.float32)
+                              for a, b in label_ranges)
+            else:
+                label = jnp.zeros((rows, self.graph.label_width()),
+                                  jnp.float32)
+            mask = jnp.ones((rows,), jnp.float32)
             top, _, _ = pipeline(gather(params), data, label, mask,
                                  jax.random.PRNGKey(0), net_state)
             return jax.lax.pmean(top, model_axis)
 
-        ds = P(data_axis, *([None] * (len(data_shape) - 1)))
-        out_spec = P(data_axis, *([None] * len(out_sd.shape)))
+        if sp > 1:
+            ds = P(data_axis, *([None] * (len(data_shape) - 2)), seq_axis)
+            out_spec = P(data_axis, seq_axis,
+                         *([None] * (len(out_sd.shape) - 1)))
+            axes = {data_axis, pipe_axis, model_axis, seq_axis}
+        else:
+            ds = P(data_axis, *([None] * (len(data_shape) - 1)))
+            out_spec = P(data_axis, *([None] * len(out_sd.shape)))
+            axes = {data_axis, pipe_axis, model_axis}
         wrapped = jax.shard_map(step, mesh=self.mesh.mesh,
                                 in_specs=(pspecs, P(), ds),
                                 out_specs=out_spec,
-                                axis_names={data_axis, pipe_axis,
-                                            model_axis})
+                                axis_names=axes)
         fn = jax.jit(wrapped)
         return lambda params, net_state, data: {_TOP: fn(params, net_state,
                                                          data)}
@@ -877,7 +999,9 @@ class Trainer:
         """Resolve (and cache) the jitted train step for the active
         parallelism mode — one dispatch point for update() and the cost
         probe."""
-        mode = "sp" if self._sp > 1 else "pp" if self._pp > 1 else "std"
+        # pp wins when both are set: the pp step runs the seq schedule
+        # inside its stages (pp x sp)
+        mode = "pp" if self._pp > 1 else "sp" if self._sp > 1 else "std"
         # the pp body closes over probe shapes derived from the batch shape;
         # std/sp recompile via jit shape polymorphism, pp must key on it
         key = (do_update, mode, np.shape(batch.data) if mode == "pp" else None)
@@ -1130,8 +1254,9 @@ class Trainer:
             if self._eval_step_fn is None or self._eval_step_fn[0] != pp_key:
                 self._eval_step_fn = (
                     pp_key, self._make_pp_eval_step(np.shape(batch.data)))
-            data = self._device_normalize(self.mesh.shard_batch(batch.data),
-                                          batch)
+            data = (self._shard_seq_batch(batch.data) if self._sp > 1
+                    else self.mesh.shard_batch(batch.data))
+            data = self._device_normalize(data, batch)
             return self._eval_step_fn[1](self.params, self.net_state, data)
         if self._sp > 1:
             key = ("sp", tuple(extract))

@@ -28,13 +28,17 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 PP_TESTS = [
     "tests/test_parallel_ext.py::test_config_driven_pipeline_matches_unsharded",
-    "tests/test_parallel_ext.py::test_pipeline_rejects_cross_stage_skip",
+    "tests/test_parallel_ext.py::test_pipeline_cross_stage_skip_matches_unsharded",
     "tests/test_parallel_ext.py::test_pipeline_rejects_stateful_body",
     "tests/test_parallel_ext.py::test_pipeline_bn_exact_match_single_microbatch",
     "tests/test_parallel_ext.py::test_pipeline_bn_microbatched_trains_and_evals",
     "tests/test_parallel_ext.py::test_pipeline_composes_with_tensor_parallel",
     "tests/test_parallel_ext.py::test_pipeline_moe_lm_matches_unsharded",
     "tests/test_parallel_ext.py::test_pp_params_shard_at_rest_over_pipe",
+    "tests/test_parallel_ext.py::test_pipeline_heterogeneous_boundaries_match_unsharded",
+    "tests/test_parallel_ext.py::test_pipeline_tp_slices_s2d_stem_conv",
+    "tests/test_parallel_ext.py::test_pipeline_composes_with_seq_parallel",
+    "tests/test_parallel_ext.py::test_pipeline_inplace_layer_in_later_stage",
 ]
 
 
