@@ -268,12 +268,27 @@ class Network:
                     # the stage-local node map, so each boundary carries
                     # the latest value at its cut)
                     node_stage.setdefault(ni, s)
+        # the loss tail runs on the reassembled batch seeded with ONLY the
+        # top body node — a tail layer reading any other body node (e.g.
+        # an auxiliary loss head) has no value there; fail fast
+        top_node = g.layers[n_body - 1].nindex_out[0]
+        tail_avail = {top_node}
+        for li in range(n_body, len(g.layers)):
+            spec = g.layers[li]
+            for ni in spec.nindex_in:
+                if ni not in tail_avail:
+                    raise ValueError(
+                        f"pipeline_parallel: loss-tail layer "
+                        f"{spec.name!r} reads node "
+                        f"{g.node_names[ni]!r}, but the tail is seeded "
+                        "with the top body node only — auxiliary loss "
+                        "heads cannot pipeline")
+            tail_avail.update(spec.nindex_out)
         # carried set per boundary i: nodes produced in stages <= i still
         # needed after i — the final body node is "consumed" by the loss
         # tail, so it is carried to the end. Boundary shapes/counts may
         # differ per cut: the trainer packs each boundary's carried nodes
         # into one flat max-size ring register (_pp_pipeline_fn pack).
-        top_node = g.layers[n_body - 1].nindex_out[0]
         last_consumer[top_node] = len(ranges)
         self._stage_carried = [
             sorted(ni for ni, s_prod in node_stage.items()

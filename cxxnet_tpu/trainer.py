@@ -160,9 +160,6 @@ class Trainer:
             if self.graph.extra_data_num:
                 raise ValueError("pipeline_parallel does not support "
                                  "extra_data")
-            if any(n is not None for n in self._metric_nodes):
-                raise ValueError("pipeline_parallel supports metrics on the "
-                                 "top node only")
             if self.batch_size % (self.mesh.data_parallel
                                   * self._pp_microbatch):
                 raise ValueError(
@@ -171,6 +168,19 @@ class Trainer:
                     f"{self.mesh.data_parallel}x{self._pp_microbatch}")
             # validates staging and fails fast on unpipelinable graphs
             self._pp_ranges = self.net.stage_partition(self._pp)
+            # non-top metric/extract nodes must be BODY nodes — their
+            # per-microbatch values are banked through the schedule's
+            # stat sink and reassembled (nodes inside the loss tail other
+            # than the top have no bank)
+            n_body = self._pp_ranges[-1][1]
+            body_nodes = {ni for li in range(n_body)
+                          for ni in self.graph.layers[li].nindex_out}
+            for name in self._needed_nodes():
+                ni = self.graph.node_names.index(name)
+                if ni not in body_nodes:
+                    raise ValueError(
+                        f"pipeline_parallel: metric/extract node {name!r} "
+                        "is not produced in the pipeline body")
 
     # Layers whose apply is correct on a local sequence shard under
     # shard_map (mha switches to the ring path, posembed offset-indexes
@@ -517,11 +527,62 @@ class Trainer:
             axis_names={data_axis, seq_axis})
         return jax.jit(wrapped, donate_argnums=(0, 1, 2, 3))
 
-    def _pp_probe_shapes(self, data_shape, train: bool = True):
+    def _pp_row_specs(self, out_sd, node_sds):
+        """out_specs for the pp steps' nodes dict: batch-sharded rows
+        (dim 1 = tokens under sp) for the top output and every captured
+        node — one definition for the train AND eval steps."""
+        from jax.sharding import PartitionSpec as P
+        data_axis, seq_axis = self.mesh.data_axis, self.mesh.seq_axis
+
+        def row_spec(rank):
+            if self._sp > 1 and rank >= 2:
+                return P(data_axis, seq_axis, *([None] * (rank - 2)))
+            return P(data_axis, *([None] * (rank - 1)))
+        specs = {_TOP: row_spec(1 + len(out_sd.shape))}
+        specs.update({name: row_spec(1 + len(sd.shape))
+                      for name, sd in node_sds.items()})
+        return specs
+
+    @staticmethod
+    def _pp_merge_banks(stats, capture, model_axis):
+        """(inside the pp shard_map) pop each captured node's
+        (M, mb, *dims) stat-sink bank, restore microbatch-major row
+        order, and pmean over 'model' so replicated peers agree —
+        shared by the train and eval steps."""
+        nodes = {}
+        for name in capture:
+            bank = stats.pop("_node:" + name)
+            nodes[name] = jax.lax.pmean(
+                bank.reshape((-1,) + bank.shape[2:]), model_axis)
+        return nodes
+
+    def _pp_capture_plan(self, capture):
+        """{name: (node_index, owner_stage)} for captured body nodes —
+        owner = the LAST stage producing the node (in-place rewrites
+        included), where its final value exists."""
+        plan = {}
+        for name in capture:
+            ni = self.graph.node_names.index(name)
+            owner = None
+            for k, (lo, hi) in enumerate(self._pp_ranges):
+                for li in range(lo, hi):
+                    if ni in self.graph.layers[li].nindex_out:
+                        owner = k
+            if owner is None:
+                raise ValueError(
+                    f"pipeline_parallel: node {name!r} is not produced in "
+                    "the pipeline body")
+            plan[name] = (ni, owner)
+        return plan
+
+    def _pp_probe_shapes(self, data_shape, train: bool = True,
+                         cap_plan=None):
         """Per-microbatch boundary / final-output / batch-stat
         ShapeDtypeStructs for the pipeline ring register, via eval_shape
         over the stage chain. ``stats`` is the union of every stage's
-        batch_norm moment structure (train only; empty at eval)."""
+        batch_norm moment structure (train only; empty at eval) plus one
+        "_node:<name>" bank entry of shape (M, mb, ...) per captured
+        node in ``cap_plan``."""
         mb = data_shape[0] // self.mesh.data_parallel // self._pp_microbatch
         rng0 = jax.random.PRNGKey(0)
         sp = self._sp
@@ -534,18 +595,31 @@ class Trainer:
         if sp > 1:
             local[-1] //= sp
         seed = jax.ShapeDtypeStruct((mb,) + tuple(local), jnp.float32)
+        cap_plan = cap_plan or {}
+        M = self._pp_microbatch
+        cap_at = lambda k: [ni for _name, (ni, o) in cap_plan.items()
+                            if o == k]
         boundaries = []        # per boundary i: {node_index: sd} (with mb)
         stats: Dict[str, Any] = {}
+        cap_sds: Dict[int, Any] = {}
         for k, (lo, hi) in enumerate(self._pp_ranges[:-1]):
-            seed, st = jax.eval_shape(
-                lambda p, s, x, _lo=lo, _hi=hi, _w=tuple(carried[k]):
+            want = list(carried[k]) + [ni for ni in cap_at(k)
+                                       if ni not in carried[k]]
+            nd, st = jax.eval_shape(
+                lambda p, s, x, _lo=lo, _hi=hi, _w=tuple(want):
                     self.net.apply_stage(_lo, _hi, p, x, rng0, train, s,
                                          want=list(_w)),
                 self.params, self.net_state, seed)
             stats.update(st)
+            cap_sds.update({ni: nd[ni] for ni in cap_at(k)})
+            seed = {ni: nd[ni] for ni in carried[k]}
             boundaries.append(seed)
         lo, hi = self._pp_ranges[-1]
         n_body = hi
+        top_idx = self.graph.layers[n_body - 1].nindex_out[0]
+        last_want = [top_idx] + [ni for ni in cap_at(len(self._pp_ranges)
+                                                    - 1)
+                                 if ni != top_idx]
 
         msk = jax.ShapeDtypeStruct((mb,), jnp.float32)
         if sp > 1:
@@ -554,44 +628,63 @@ class Trainer:
                    for a, b in self.graph.label_range}
 
             def last(p, s, x, lslices, mask):
-                y, st = self.net.apply_stage(lo, hi, p, x, rng0, train, s)
-                res = self.net.apply_tail(n_body, p, {}, y, None, mask,
-                                          rng0, train,
+                nd, st = self.net.apply_stage(lo, hi, p, x, rng0, train, s,
+                                              want=last_want)
+                res = self.net.apply_tail(n_body, p, {}, nd[top_idx], None,
+                                          mask, rng0, train,
                                           label_slices=lslices)
-                return res.out, st
+                return res.out, nd, st
         else:
             lab = jax.ShapeDtypeStruct((mb, self.graph.label_width()),
                                        jnp.float32)
 
             def last(p, s, x, label, mask):
-                y, st = self.net.apply_stage(lo, hi, p, x, rng0, train, s)
-                res = self.net.apply_tail(n_body, p, {}, y, label, mask,
-                                          rng0, train)
-                return res.out, st
-        out, st = jax.eval_shape(last, self.params, self.net_state, seed,
-                                 lab, msk)
+                nd, st = self.net.apply_stage(lo, hi, p, x, rng0, train, s,
+                                              want=last_want)
+                res = self.net.apply_tail(n_body, p, {}, nd[top_idx],
+                                          label, mask, rng0, train)
+                return res.out, nd, st
+        out, nd_last, st = jax.eval_shape(last, self.params,
+                                          self.net_state, seed, lab, msk)
         stats.update(st)
+        cap_sds.update({ni: nd_last[ni]
+                        for ni in cap_at(len(self._pp_ranges) - 1)})
         # "_aux:<layer>" sink entries are per-stage scalar losses (moe) —
         # they ride the schedule's differentiated scalar accumulator, not
         # the stats structure
         stats = {k: v for k, v in stats.items() if not k.startswith("_aux:")}
+        # captured nodes bank per-microbatch slots through the stat sink
+        for name, (ni, _owner) in cap_plan.items():
+            sd = cap_sds[ni]
+            stats["_node:" + name] = jax.ShapeDtypeStruct(
+                (M,) + tuple(sd.shape), sd.dtype)
         strip = lambda a: jax.ShapeDtypeStruct(tuple(a.shape)[1:], a.dtype)
         return ([{ni: strip(sd) for ni, sd in b.items()}
                  for b in boundaries], strip(out), stats)
 
-    def _pp_pipeline_fn(self, data_shape, train: bool):
+    def _pp_pipeline_fn(self, data_shape, train: bool, capture=()):
         """Local GPipe body (runs under shard_map): the stage schedule over
         the 'pipe' axis on this device's batch rows, with the loss layers
         folded into the LAST stage so all collectives chain off the ring
         (parallel/pipeline.py pipeline_apply_stages). ``state`` threads
         read-only into the stages (batch_norm running stats at eval);
         train-time BN moments come back in ``stats`` for the trainer's
-        post-ring merge."""
+        post-ring merge. ``capture``: body node names whose full-batch
+        values the caller needs (metric bindings / extraction) — each
+        owner stage banks its per-microbatch value into a "_node:<name>"
+        stat-sink slot (``zeros(M,...).at[m].set(v)`` — the schedule's
+        tick-sum over disjoint slots IS the bank, and the pipe-axis psum
+        the merge). Known cost: the sink accumulator tick-adds the FULL
+        (M, mb, ...) bank every tick (O(M + S) bank traversals per step
+        vs the M slot-writes a dedicated scan carry would need) — fine
+        for the eval path and for the occasional non-top train metric,
+        not for routinely capturing large activations every step."""
         from .parallel.pipeline import pipeline_apply_stages
         net, ranges = self.net, self._pp_ranges
         n_body = ranges[-1][1]
-        boundary_sds, out_sd, stats_sd = self._pp_probe_shapes(data_shape,
-                                                               train)
+        cap_plan = self._pp_capture_plan(capture)
+        boundary_sds, out_sd, stats_sd = self._pp_probe_shapes(
+            data_shape, train, cap_plan=cap_plan)
         # HETEROGENEOUS boundaries ride one flat max-size ring register:
         # each stage packs its boundary's CARRIED node set (every node
         # produced at or before the cut and consumed after it — so
@@ -673,13 +766,32 @@ class Trainer:
                                          jax.lax.axis_index(seq_axis))
             # the microbatch index folds in per microbatch below so masks
             # are independent across microbatches too
+            cap_at = {}
+            for name, (ni, owner) in cap_plan.items():
+                cap_at.setdefault(owner, []).append((name, ni))
+
+            def bank_captured(st, nd, k, m):
+                # slot-bank this stage's captured node values; the
+                # schedule's liveness gate zeroes drain-tick garbage and
+                # its tick-sum accumulates the disjoint slots
+                for name, ni in cap_at.get(k, ()):
+                    v = nd[ni]
+                    bank = jnp.zeros((M,) + v.shape, v.dtype)
+                    st["_node:" + name] = bank.at[
+                        jnp.clip(m, 0, M - 1)].set(v)
+                return st
+
             def mid_fn(pp_, xx, m, k, _lo, _hi):
                 seed = xx if k == 0 else unpack(xx, k - 1)
+                want = list(carried[k]) + [ni for _n, ni in
+                                           cap_at.get(k, ())
+                                           if ni not in carried[k]]
                 nd, st = net.apply_stage(_lo, _hi, pp_, seed,
                                          jax.random.fold_in(rng, m),
                                          train, state,
-                                         want=list(carried[k]), **tp_kw)
+                                         want=want, **tp_kw)
                 aux, st = split_aux(st)
+                st = bank_captured(st, nd, k, m)
                 # tie the scalar to a stage output so its JAX type is
                 # varying even for stages with no aux loss — a bare
                 # constant would type-mismatch the backward's varying
@@ -695,20 +807,29 @@ class Trainer:
 
             last_k = len(ranges) - 1
 
+            top_idx = self.graph.layers[n_body - 1].nindex_out[0]
+            last_want = [top_idx] + [ni for _n, ni in
+                                     cap_at.get(last_k, ())
+                                     if ni != top_idx]
+
             def last_fn(pp_, xx, aux_mb, m):
                 label_mb, mask_mb = aux_mb
                 rng_m = jax.random.fold_in(rng, m)
-                y, st = net.apply_stage(lo, hi, pp_, unpack(xx, last_k - 1),
-                                        rng_m, train, state, **tp_kw)
+                nd, st = net.apply_stage(lo, hi, pp_,
+                                         unpack(xx, last_k - 1),
+                                         rng_m, train, state,
+                                         want=last_want, **tp_kw)
                 aux, st = split_aux(st)
+                st = bank_captured(st, nd, last_k, m)
                 if sp > 1:
                     res = net.apply_tail(
-                        n_body, pp_, {}, y, None, mask_mb, rng_m, train,
+                        n_body, pp_, {}, nd[top_idx], None, mask_mb,
+                        rng_m, train,
                         label_slices=dict(zip(label_ranges, label_mb)),
                         seq_axis=seq_axis, data_axis=data_axis)
                 else:
-                    res = net.apply_tail(n_body, pp_, {}, y, label_mb,
-                                         mask_mb, rng_m, train)
+                    res = net.apply_tail(n_body, pp_, {}, nd[top_idx],
+                                         label_mb, mask_mb, rng_m, train)
                 return res.out, res.loss + aux, pad_stats(st)
             fns.append(last_fn)
             # label: one (rows, W) array, or under sp a tuple of
@@ -729,7 +850,11 @@ class Trainer:
             # the M of them to match the non-pipelined per-batch loss
             return top, loss_sum / M, stats
 
-        return body, out_sd, tp_plan
+        node_sds = {name: jax.ShapeDtypeStruct(
+                        tuple(stats_sd["_node:" + name].shape)[2:],
+                        stats_sd["_node:" + name].dtype)
+                    for name in cap_plan}
+        return body, out_sd, tp_plan, node_sds
 
     def _pp_bn_momenta(self) -> Dict[str, float]:
         """bn_momentum per moving-average batch_norm layer — the post-ring
@@ -764,8 +889,14 @@ class Trainer:
         sp, seq_axis = self._sp, self.mesh.seq_axis
         mean_axes = (data_axis, model_axis) + ((seq_axis,) if sp > 1
                                                else ())
-        pipeline, out_sd, tp_plan = self._pp_pipeline_fn(data_shape,
-                                                         train=True)
+        needed = tuple(self._needed_nodes()) if self.eval_train else ()
+        # the top node already arrives via the schedule's out accumulator —
+        # a metric bound to its NAME aliases it instead of banking a copy
+        top_name = self.graph.node_names[
+            self.graph.layers[self._pp_ranges[-1][1] - 1].nindex_out[0]]
+        captured = tuple(n for n in needed if n != top_name)
+        pipeline, out_sd, tp_plan, node_sds = self._pp_pipeline_fn(
+            data_shape, train=True, capture=captured)
         bn_ema = self._pp_bn_momenta()
         M = self._pp_microbatch
         rep = P()
@@ -811,6 +942,11 @@ class Trainer:
             # model peers compute identical outputs (activations are
             # all-gathered); pmean makes them invariant for the out_specs
             out = jax.lax.pmean(out, model_axis)
+            nodes = {_TOP: out}
+            nodes.update(self._pp_merge_banks(stats, captured, model_axis))
+            for name in needed:
+                if name == top_name:
+                    nodes[name] = out
             new_state = net_state
             if bn_ema:
                 # stats arrive summed over the M live microbatches and
@@ -832,37 +968,44 @@ class Trainer:
             params, opt_state, accum = _apply_grads(
                 opt, period, do_update, params, opt_state, accum, grads,
                 sched)
-            return (params, opt_state, new_state, accum, loss, out,
+            return (params, opt_state, new_state, accum, loss, nodes,
                     jax.random.fold_in(rng, 1))
 
         if sp > 1:
             ds = P(data_axis, *([None] * (len(data_shape) - 2)), seq_axis)
             lspec = tuple(P(data_axis, seq_axis)
                           for _ in self.graph.label_range)
-            out_spec = P(data_axis, seq_axis,
-                         *([None] * (len(out_sd.shape) - 1)))
             axes = {data_axis, pipe_axis, model_axis, seq_axis}
         else:
             ds = P(data_axis, *([None] * (len(data_shape) - 1)))
             lspec = P(data_axis)
-            out_spec = P(data_axis, *([None] * len(out_sd.shape)))
             axes = {data_axis, pipe_axis, model_axis}
+        nodes_spec = self._pp_row_specs(out_sd, node_sds)
+        for name in needed:
+            if name == top_name:
+                nodes_spec[name] = nodes_spec[_TOP]
         accum_spec = pspecs if period > 1 else rep
         wrapped = jax.shard_map(
             step, mesh=self.mesh.mesh,
             in_specs=(pspecs, opt_pspecs, rep, accum_spec, ds,
                       lspec, P(data_axis), rep, rep),
-            out_specs=(pspecs, opt_pspecs, rep, accum_spec, rep, out_spec,
-                       rep),
+            out_specs=(pspecs, opt_pspecs, rep, accum_spec, rep,
+                       nodes_spec, rep),
             axis_names=axes)
         return jax.jit(wrapped, donate_argnums=(0, 1, 2, 3))
 
-    def _make_pp_eval_step(self, data_shape):
+    def _make_pp_eval_step(self, data_shape, extract=()):
         from jax.sharding import PartitionSpec as P
         data_axis, pipe_axis = self.mesh.data_axis, self.mesh.pipe_axis
         model_axis = self.mesh.model_axis
         sp, seq_axis = self._sp, self.mesh.seq_axis
-        pipeline, out_sd, _ = self._pp_pipeline_fn(data_shape, train=False)
+        wanted = tuple(dict.fromkeys(
+            tuple(self._needed_nodes()) + tuple(extract)))
+        top_name = self.graph.node_names[
+            self.graph.layers[self._pp_ranges[-1][1] - 1].nindex_out[0]]
+        capture = tuple(n for n in wanted if n != top_name)
+        pipeline, out_sd, _, node_sds = self._pp_pipeline_fn(
+            data_shape, train=False, capture=capture)
         pspecs = self._pp_fsdp_specs(self.params)
         gather = self._pp_gather_fn(pspecs)
         label_ranges = list(self.graph.label_range)
@@ -876,26 +1019,30 @@ class Trainer:
                 label = jnp.zeros((rows, self.graph.label_width()),
                                   jnp.float32)
             mask = jnp.ones((rows,), jnp.float32)
-            top, _, _ = pipeline(gather(params), data, label, mask,
-                                 jax.random.PRNGKey(0), net_state)
-            return jax.lax.pmean(top, model_axis)
+            top, _, stats = pipeline(gather(params), data, label, mask,
+                                     jax.random.PRNGKey(0), net_state)
+            nodes = {_TOP: jax.lax.pmean(top, model_axis)}
+            nodes.update(self._pp_merge_banks(stats, capture, model_axis))
+            for name in wanted:
+                if name == top_name:
+                    nodes[name] = nodes[_TOP]
+            return nodes
 
         if sp > 1:
             ds = P(data_axis, *([None] * (len(data_shape) - 2)), seq_axis)
-            out_spec = P(data_axis, seq_axis,
-                         *([None] * (len(out_sd.shape) - 1)))
             axes = {data_axis, pipe_axis, model_axis, seq_axis}
         else:
             ds = P(data_axis, *([None] * (len(data_shape) - 1)))
-            out_spec = P(data_axis, *([None] * len(out_sd.shape)))
             axes = {data_axis, pipe_axis, model_axis}
+        nodes_spec = self._pp_row_specs(out_sd, node_sds)
+        for name in wanted:
+            if name == top_name:
+                nodes_spec[name] = nodes_spec[_TOP]
         wrapped = jax.shard_map(step, mesh=self.mesh.mesh,
                                 in_specs=(pspecs, P(), ds),
-                                out_specs=out_spec,
+                                out_specs=nodes_spec,
                                 axis_names=axes)
-        fn = jax.jit(wrapped)
-        return lambda params, net_state, data: {_TOP: fn(params, net_state,
-                                                         data)}
+        return jax.jit(wrapped)
 
     def _make_train_step(self, do_update: bool):
         net, opt, period = self.net, self.optimizer, self.update_period
@@ -1081,11 +1228,10 @@ class Trainer:
         data, label = staged.data, staged.label
         if self._pp > 1:
             (self.params, self.opt_state, self.net_state, accum, loss,
-             top, self._rng_key) = step(
+             nodes, self._rng_key) = step(
                  self.params, self.opt_state, self.net_state,
                  accum_in, data, label, mask, self._rng_key,
                  self._sched_scalars())
-            nodes = {_TOP: top}
         elif self._sp > 1:
             (self.params, self.opt_state, self.net_state, accum, loss,
              nodes, self._rng_key) = step(
@@ -1244,16 +1390,13 @@ class Trainer:
     def _eval_nodes(self, batch: DataBatch,
                     extract: Tuple[str, ...] = ()) -> Dict[str, jax.Array]:
         if self._pp > 1:
-            if extract:
-                raise ValueError(
-                    "pipeline_parallel supports extraction of the top node "
-                    "only")
             # the pp body closes over the probe shapes, so a changed batch
             # shape must rebuild rather than silently reuse a stale pipeline
-            pp_key = ("pp", np.shape(batch.data))
+            pp_key = ("pp", np.shape(batch.data), tuple(extract))
             if self._eval_step_fn is None or self._eval_step_fn[0] != pp_key:
                 self._eval_step_fn = (
-                    pp_key, self._make_pp_eval_step(np.shape(batch.data)))
+                    pp_key, self._make_pp_eval_step(np.shape(batch.data),
+                                                    extract))
             data = (self._shard_seq_batch(batch.data) if self._sp > 1
                     else self.mesh.shard_batch(batch.data))
             data = self._device_normalize(data, batch)

@@ -714,3 +714,57 @@ def test_pipeline_inplace_layer_in_later_stage():
     for b in it:
         tr_pp.update(b)              # trains without error
     assert np.isfinite(tr_pp.last_loss)
+
+
+def test_pipeline_nontop_metrics_and_extraction():
+    """Metric bindings and extract_feature on BODY nodes work under pp:
+    per-microbatch values bank through the stat sink and reassemble.
+    Values must match the unsharded trainer exactly (no dropout)."""
+    cfg_txt = (PP_MLP_CFG + "metric[label,a1] = rmse\n"
+               + "metric[label,out] = error\n")  # top by NAME: alias path
+    cfg = parse_config_string(cfg_txt)
+    tr_pp = Trainer(cfg + [("pipeline_microbatch", "4"),
+                           ("eval_train", "1")],
+                    mesh_ctx=_pp_mesh(pp=2, dp=2))
+    tr_ref = Trainer(cfg + [("eval_train", "1")],
+                     mesh_ctx=_pp_mesh(pp=1, dp=1))
+    tr_pp.init_model()
+    tr_ref.init_model()
+    it = create_iterator(parse_config_string(PP_ITER))
+    b0 = it.next()
+    # extraction of mid-stage and cross-boundary nodes (dropout-free
+    # eval path -> deterministic)
+    for node in ("h1", "a1", "a2"):
+        np.testing.assert_allclose(
+            tr_pp.extract_feature(b0, node),
+            tr_ref.extract_feature(b0, node), rtol=1e-4, atol=1e-6)
+    # eval metrics bound to a non-top node agree
+    it.before_first()
+    e_pp = tr_pp.evaluate(it, "e")
+    e_ref = tr_ref.evaluate(it, "e")
+    for v_pp, v_ref in zip(e_pp.split(":")[1:], e_ref.split(":")[1:]):
+        np.testing.assert_allclose(float(v_pp.split("\t")[0]),
+                                   float(v_ref.split("\t")[0]), rtol=1e-3)
+    # train-metric capture through the schedule (eval_train=1)
+    it.before_first()
+    for b in it:
+        tr_pp.update(b)
+        tr_ref.update(b)
+    np.testing.assert_allclose(tr_pp.last_loss, tr_ref.last_loss,
+                               rtol=2e-4)
+    m_pp = tr_pp.train_metric_report()
+    m_ref = tr_ref.train_metric_report()
+    for v_pp, v_ref in zip(m_pp.split(":")[1:], m_ref.split(":")[1:]):
+        np.testing.assert_allclose(float(v_pp.split("\t")[0]),
+                                   float(v_ref.split("\t")[0]), rtol=1e-3)
+
+
+def test_pipeline_rejects_aux_loss_head_in_tail():
+    """A second loss head reading a non-top body node cannot pipeline —
+    clean init error, not a trace-time KeyError."""
+    bad = PP_MLP_CFG.replace(
+        "layer[+0] = softmax",
+        "layer[a1->aux] = fullc:fcaux\n  nhidden = 5\n"
+        "layer[out->out] = softmax\nlayer[aux->aux] = softmax")
+    with pytest.raises(ValueError, match="tail"):
+        Trainer(parse_config_string(bad), mesh_ctx=_pp_mesh(pp=2, dp=2))

@@ -39,6 +39,7 @@ PP_TESTS = [
     "tests/test_parallel_ext.py::test_pipeline_tp_slices_s2d_stem_conv",
     "tests/test_parallel_ext.py::test_pipeline_composes_with_seq_parallel",
     "tests/test_parallel_ext.py::test_pipeline_inplace_layer_in_later_stage",
+    "tests/test_parallel_ext.py::test_pipeline_nontop_metrics_and_extraction",
 ]
 
 
