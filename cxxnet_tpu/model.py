@@ -268,28 +268,35 @@ class Network:
                     # the stage-local node map, so each boundary carries
                     # the latest value at its cut)
                     node_stage.setdefault(ni, s)
-        # the loss tail runs on the reassembled batch seeded with ONLY the
-        # top body node — a tail layer reading any other body node (e.g.
-        # an auxiliary loss head) has no value there; fail fast
+        # the loss tail runs on the reassembled batch, seeded with the top
+        # body node PLUS any other body node a tail layer reads (auxiliary
+        # loss heads, GoogLeNet-style): each extra seed rides the carried
+        # register to the last stage like any cross-stage skip
         top_node = g.layers[n_body - 1].nindex_out[0]
         tail_avail = {top_node}
+        tail_reads = set()
         for li in range(n_body, len(g.layers)):
             spec = g.layers[li]
             for ni in spec.nindex_in:
                 if ni not in tail_avail:
-                    raise ValueError(
-                        f"pipeline_parallel: loss-tail layer "
-                        f"{spec.name!r} reads node "
-                        f"{g.node_names[ni]!r}, but the tail is seeded "
-                        "with the top body node only — auxiliary loss "
-                        "heads cannot pipeline")
+                    if ni not in node_stage:
+                        raise ValueError(
+                            f"pipeline_parallel: loss-tail layer "
+                            f"{spec.name!r} reads node "
+                            f"{g.node_names[ni]!r}, which no pipeline "
+                            "body stage produces")
+                    tail_reads.add(ni)
+                    tail_avail.add(ni)
             tail_avail.update(spec.nindex_out)
+        self._tail_seeds = sorted({top_node} | tail_reads)
         # carried set per boundary i: nodes produced in stages <= i still
-        # needed after i — the final body node is "consumed" by the loss
-        # tail, so it is carried to the end. Boundary shapes/counts may
-        # differ per cut: the trainer packs each boundary's carried nodes
-        # into one flat max-size ring register (_pp_pipeline_fn pack).
-        last_consumer[top_node] = len(ranges)
+        # needed after i — every tail seed (the final body node, plus aux
+        # loss-head inputs) is "consumed" by the loss tail, so it is
+        # carried to the end. Boundary shapes/counts may differ per cut:
+        # the trainer packs each boundary's carried nodes into one flat
+        # max-size ring register (_pp_pipeline_fn pack).
+        for ni in self._tail_seeds:
+            last_consumer[ni] = len(ranges)
         self._stage_carried = [
             sorted(ni for ni, s_prod in node_stage.items()
                    if s_prod <= i and last_consumer.get(ni, -1) > i)
@@ -425,22 +432,27 @@ class Network:
         return nodes[g.layers[hi - 1].nindex_out[0]], sink
 
     def apply_tail(self, body_hi: int, params: Params, state: NetState,
-                   top: jax.Array, label: Optional[jax.Array],
+                   seeds: Dict[int, jax.Array],
+                   label: Optional[jax.Array],
                    mask: jax.Array, rng: jax.Array,
                    train: bool,
                    label_slices: Optional[Dict[Tuple[int, int],
                                                jax.Array]] = None,
                    seq_axis: Optional[str] = None,
-                   data_axis: Optional[str] = None) -> ForwardResult:
-        """Run the loss layers [body_hi, end) on the full-batch pipeline
-        output ``top`` (they are row-wise, so GSPMD batch sharding
-        applies). ``label_slices``/``seq_axis``/``data_axis`` mirror
-        ``apply`` for the sequence-parallel pipeline: pre-sliced
-        width-sharded labels, and manual axes bound in the loss layers'
-        ctx."""
+                   data_axis: Optional[str] = None,
+                   want: Optional[List[int]] = None) -> ForwardResult:
+        """Run the loss layers [body_hi, end) on the pipeline's output
+        (they are row-wise, so GSPMD batch sharding applies). ``seeds``
+        is a {node_index: value} dict: the top body node plus any other
+        body node a tail layer reads (auxiliary loss heads —
+        ``_tail_seeds``). ``want``: node indices whose POST-tail values
+        the caller captures (metric bindings / extraction on nodes the
+        tail writes) — returned in ``result.nodes`` keyed by index.
+        ``label_slices``/``seq_axis``/``data_axis`` mirror ``apply`` for
+        the sequence-parallel pipeline: pre-sliced width-sharded labels,
+        and manual axes bound in the loss layers' ctx."""
         g = self.graph
-        nodes: Dict[int, jax.Array] = {
-            g.layers[body_hi - 1].nindex_out[0]: top}
+        nodes: Dict[int, jax.Array] = dict(seeds)
         new_state: NetState = dict(state)
         total_loss = jnp.zeros((), jnp.float32)
         for li in range(body_hi, len(g.layers)):
@@ -464,7 +476,9 @@ class Network:
                 total_loss = total_loss + layer.loss(
                     outputs, lab.astype(jnp.float32), mask)
         out = nodes[g.layers[-1].nindex_out[0]]
-        return ForwardResult(loss=total_loss, state=new_state, nodes=None,
+        return ForwardResult(loss=total_loss, state=new_state,
+                             nodes={ni: nodes[ni] for ni in want}
+                             if want else None,
                              out=out)
 
     def node_value(self, result: ForwardResult, name: str) -> jax.Array:

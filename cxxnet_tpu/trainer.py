@@ -557,22 +557,31 @@ class Trainer:
         return nodes
 
     def _pp_capture_plan(self, capture):
-        """{name: (node_index, owner_stage)} for captured body nodes —
-        owner = the LAST stage producing the node (in-place rewrites
-        included), where its final value exists."""
+        """{name: (node_index, owner_stage, from_tail)} for captured
+        nodes — owner = the LAST place producing the node (in-place
+        rewrites included), where its final value exists. ``from_tail``
+        marks nodes (re)written by a loss-tail layer: they bank from the
+        tail's node map on the last stage, not from the body stage that
+        first produced them (a tail ``softmax out->out`` rewrite must
+        yield the post-softmax value, like the unsharded node map)."""
         plan = {}
+        last_k = len(self._pp_ranges) - 1
+        n_body = self._pp_ranges[-1][1]
         for name in capture:
             ni = self.graph.node_names.index(name)
-            owner = None
+            owner, from_tail = None, False
             for k, (lo, hi) in enumerate(self._pp_ranges):
                 for li in range(lo, hi):
                     if ni in self.graph.layers[li].nindex_out:
                         owner = k
+            for li in range(n_body, len(self.graph.layers)):
+                if ni in self.graph.layers[li].nindex_out:
+                    owner, from_tail = last_k, True
             if owner is None:
                 raise ValueError(
-                    f"pipeline_parallel: node {name!r} is not produced in "
-                    "the pipeline body")
-            plan[name] = (ni, owner)
+                    f"pipeline_parallel: node {name!r} is not produced by "
+                    "the pipeline body or the loss tail")
+            plan[name] = (ni, owner, from_tail)
         return plan
 
     def _pp_probe_shapes(self, data_shape, train: bool = True,
@@ -597,8 +606,10 @@ class Trainer:
         seed = jax.ShapeDtypeStruct((mb,) + tuple(local), jnp.float32)
         cap_plan = cap_plan or {}
         M = self._pp_microbatch
-        cap_at = lambda k: [ni for _name, (ni, o) in cap_plan.items()
-                            if o == k]
+        cap_at = lambda k: [ni for _n, (ni, o, ft) in cap_plan.items()
+                            if o == k and not ft]
+        tail_cap = sorted({ni for _n, (ni, o, ft) in cap_plan.items()
+                           if ft})
         boundaries = []        # per boundary i: {node_index: sd} (with mb)
         stats: Dict[str, Any] = {}
         cap_sds: Dict[int, Any] = {}
@@ -616,10 +627,10 @@ class Trainer:
             boundaries.append(seed)
         lo, hi = self._pp_ranges[-1]
         n_body = hi
-        top_idx = self.graph.layers[n_body - 1].nindex_out[0]
-        last_want = [top_idx] + [ni for ni in cap_at(len(self._pp_ranges)
-                                                    - 1)
-                                 if ni != top_idx]
+        tail_seeds = self.net._tail_seeds
+        last_want = list(tail_seeds) + [
+            ni for ni in cap_at(len(self._pp_ranges) - 1)
+            if ni not in tail_seeds]
 
         msk = jax.ShapeDtypeStruct((mb,), jnp.float32)
         if sp > 1:
@@ -630,10 +641,11 @@ class Trainer:
             def last(p, s, x, lslices, mask):
                 nd, st = self.net.apply_stage(lo, hi, p, x, rng0, train, s,
                                               want=last_want)
-                res = self.net.apply_tail(n_body, p, {}, nd[top_idx], None,
-                                          mask, rng0, train,
-                                          label_slices=lslices)
-                return res.out, nd, st
+                res = self.net.apply_tail(
+                    n_body, p, {}, {ni: nd[ni] for ni in tail_seeds},
+                    None, mask, rng0, train, label_slices=lslices,
+                    want=tail_cap)
+                return res.out, nd, res.nodes or {}, st
         else:
             lab = jax.ShapeDtypeStruct((mb, self.graph.label_width()),
                                        jnp.float32)
@@ -641,20 +653,22 @@ class Trainer:
             def last(p, s, x, label, mask):
                 nd, st = self.net.apply_stage(lo, hi, p, x, rng0, train, s,
                                               want=last_want)
-                res = self.net.apply_tail(n_body, p, {}, nd[top_idx],
-                                          label, mask, rng0, train)
-                return res.out, nd, st
-        out, nd_last, st = jax.eval_shape(last, self.params,
-                                          self.net_state, seed, lab, msk)
+                res = self.net.apply_tail(
+                    n_body, p, {}, {ni: nd[ni] for ni in tail_seeds},
+                    label, mask, rng0, train, want=tail_cap)
+                return res.out, nd, res.nodes or {}, st
+        out, nd_last, tail_nd, st = jax.eval_shape(
+            last, self.params, self.net_state, seed, lab, msk)
         stats.update(st)
         cap_sds.update({ni: nd_last[ni]
                         for ni in cap_at(len(self._pp_ranges) - 1)})
+        cap_sds.update(tail_nd)
         # "_aux:<layer>" sink entries are per-stage scalar losses (moe) —
         # they ride the schedule's differentiated scalar accumulator, not
         # the stats structure
         stats = {k: v for k, v in stats.items() if not k.startswith("_aux:")}
         # captured nodes bank per-microbatch slots through the stat sink
-        for name, (ni, _owner) in cap_plan.items():
+        for name, (ni, _owner, _ft) in cap_plan.items():
             sd = cap_sds[ni]
             stats["_node:" + name] = jax.ShapeDtypeStruct(
                 (M,) + tuple(sd.shape), sd.dtype)
@@ -766,15 +780,19 @@ class Trainer:
                                          jax.lax.axis_index(seq_axis))
             # the microbatch index folds in per microbatch below so masks
             # are independent across microbatches too
-            cap_at = {}
-            for name, (ni, owner) in cap_plan.items():
-                cap_at.setdefault(owner, []).append((name, ni))
+            cap_at = {}           # owner -> [(name, ni)], body-banked
+            tail_caps = []        # [(name, ni)], banked post-tail
+            for name, (ni, owner, ft) in cap_plan.items():
+                if ft:
+                    tail_caps.append((name, ni))
+                else:
+                    cap_at.setdefault(owner, []).append((name, ni))
 
-            def bank_captured(st, nd, k, m):
+            def bank_captured(st, nd, k, m, extra=()):
                 # slot-bank this stage's captured node values; the
                 # schedule's liveness gate zeroes drain-tick garbage and
                 # its tick-sum accumulates the disjoint slots
-                for name, ni in cap_at.get(k, ()):
+                for name, ni in tuple(cap_at.get(k, ())) + tuple(extra):
                     v = nd[ni]
                     bank = jnp.zeros((M,) + v.shape, v.dtype)
                     st["_node:" + name] = bank.at[
@@ -807,10 +825,12 @@ class Trainer:
 
             last_k = len(ranges) - 1
 
-            top_idx = self.graph.layers[n_body - 1].nindex_out[0]
-            last_want = [top_idx] + [ni for _n, ni in
-                                     cap_at.get(last_k, ())
-                                     if ni != top_idx]
+            tail_seeds = net._tail_seeds
+            last_want = list(tail_seeds) + [ni for _n, ni in
+                                            cap_at.get(last_k, ())
+                                            if ni not in tail_seeds]
+
+            tail_want = sorted({ni for _n, ni in tail_caps})
 
             def last_fn(pp_, xx, aux_mb, m):
                 label_mb, mask_mb = aux_mb
@@ -820,16 +840,23 @@ class Trainer:
                                          rng_m, train, state,
                                          want=last_want, **tp_kw)
                 aux, st = split_aux(st)
-                st = bank_captured(st, nd, last_k, m)
+                seeds = {ni: nd[ni] for ni in tail_seeds}
                 if sp > 1:
                     res = net.apply_tail(
-                        n_body, pp_, {}, nd[top_idx], None, mask_mb,
+                        n_body, pp_, {}, seeds, None, mask_mb,
                         rng_m, train,
                         label_slices=dict(zip(label_ranges, label_mb)),
-                        seq_axis=seq_axis, data_axis=data_axis)
+                        seq_axis=seq_axis, data_axis=data_axis,
+                        want=tail_want)
                 else:
-                    res = net.apply_tail(n_body, pp_, {}, nd[top_idx],
-                                         label_mb, mask_mb, rng_m, train)
+                    res = net.apply_tail(n_body, pp_, {}, seeds,
+                                         label_mb, mask_mb, rng_m, train,
+                                         want=tail_want)
+                # tail-(re)written captures bank their post-tail values
+                nd_full = dict(nd)
+                nd_full.update(res.nodes or {})
+                st = bank_captured(st, nd_full, last_k, m,
+                                   extra=tail_caps)
                 return res.out, res.loss + aux, pad_stats(st)
             fns.append(last_fn)
             # label: one (rows, W) array, or under sp a tuple of
@@ -890,10 +917,14 @@ class Trainer:
         mean_axes = (data_axis, model_axis) + ((seq_axis,) if sp > 1
                                                else ())
         needed = tuple(self._needed_nodes()) if self.eval_train else ()
-        # the top node already arrives via the schedule's out accumulator —
-        # a metric bound to its NAME aliases it instead of banking a copy
+        # the accumulator node (the FINAL layer's output, post loss tail)
+        # already arrives via the schedule's out accumulator — a metric
+        # bound to its NAME aliases it instead of banking a copy. Note
+        # this is the overall-final node, not the top BODY node: a tail
+        # rewrite (softmax out->out) or aux head makes them differ, and
+        # the accumulator holds the post-tail value.
         top_name = self.graph.node_names[
-            self.graph.layers[self._pp_ranges[-1][1] - 1].nindex_out[0]]
+            self.graph.layers[-1].nindex_out[0]]
         captured = tuple(n for n in needed if n != top_name)
         pipeline, out_sd, tp_plan, node_sds = self._pp_pipeline_fn(
             data_shape, train=True, capture=captured)
@@ -1001,8 +1032,10 @@ class Trainer:
         sp, seq_axis = self._sp, self.mesh.seq_axis
         wanted = tuple(dict.fromkeys(
             tuple(self._needed_nodes()) + tuple(extract)))
+        # accumulator alias: the FINAL layer's node (post tail) — see
+        # _make_pp_train_step
         top_name = self.graph.node_names[
-            self.graph.layers[self._pp_ranges[-1][1] - 1].nindex_out[0]]
+            self.graph.layers[-1].nindex_out[0]]
         capture = tuple(n for n in wanted if n != top_name)
         pipeline, out_sd, _, node_sds = self._pp_pipeline_fn(
             data_shape, train=False, capture=capture)

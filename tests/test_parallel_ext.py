@@ -759,12 +759,54 @@ def test_pipeline_nontop_metrics_and_extraction():
                                    float(v_ref.split("\t")[0]), rtol=1e-3)
 
 
-def test_pipeline_rejects_aux_loss_head_in_tail():
-    """A second loss head reading a non-top body node cannot pipeline —
-    clean init error, not a trace-time KeyError."""
-    bad = PP_MLP_CFG.replace(
-        "layer[+0] = softmax",
+def test_pipeline_aux_loss_head_matches_unsharded():
+    """A second loss head reading a non-top body node (a GoogLeNet-style
+    auxiliary classifier) pipelines. The aux projection 'fcaux' lives in
+    STAGE 0, so its output 'aux' — read only by the loss tail — must
+    ride the carried-node ring register across the stage boundary (and
+    its cotangent must ride back), while the tail rewrite
+    'softmax out->out' exercises the multi-seed tail. Training must
+    match the unsharded run."""
+    aux = PP_MLP_CFG.replace(
+        "layer[+1:h2] = fullc:fc2",
         "layer[a1->aux] = fullc:fcaux\n  nhidden = 5\n"
-        "layer[out->out] = softmax\nlayer[aux->aux] = softmax")
-    with pytest.raises(ValueError, match="tail"):
-        Trainer(parse_config_string(bad), mesh_ctx=_pp_mesh(pp=2, dp=2))
+        "  random_type = xavier\nlayer[a1->h2] = fullc:fc2").replace(
+        "layer[+0] = softmax",
+        "layer[out->out] = softmax\nlayer[aux->aux] = softmax\n"
+        "  grad_scale = 0.3")
+    cfg = parse_config_string(aux) + [("metric[label,out]", "logloss")]
+    tr_pp = Trainer(cfg + [("pipeline_microbatch", "4")],
+                    mesh_ctx=_pp_mesh(pp=2, dp=2))
+    tr_ref = Trainer(cfg, mesh_ctx=_pp_mesh(pp=1, dp=1))
+    tr_pp.init_model()
+    tr_ref.init_model()
+    it = create_iterator(parse_config_string(PP_ITER))
+    losses_pp, losses_ref = [], []
+    for _ in range(2):
+        for b in it:
+            tr_pp.update(b)
+            losses_pp.append(tr_pp.last_loss)
+        for b in it:
+            tr_ref.update(b)
+            losses_ref.append(tr_ref.last_loss)
+    np.testing.assert_allclose(losses_pp, losses_ref, rtol=2e-4)
+    for layer in ("fc1", "fc3", "fcaux"):
+        np.testing.assert_allclose(
+            tr_pp.get_weight(layer, "wmat"),
+            tr_ref.get_weight(layer, "wmat"), rtol=2e-4, atol=1e-5)
+    # captures on tail-written nodes bank POST-tail values: 'out' is
+    # rewritten by the tail softmax (the metric[label,out] logloss above
+    # reads its probabilities), 'aux' is the accumulator node — both
+    # must match the unsharded node map exactly
+    it.before_first()
+    b0 = it.next()
+    for node in ("out", "aux"):
+        np.testing.assert_allclose(
+            tr_pp.extract_feature(b0, node),
+            tr_ref.extract_feature(b0, node), rtol=1e-4, atol=1e-6)
+    it.before_first()
+    e_pp = tr_pp.evaluate(it, "e")
+    e_ref = tr_ref.evaluate(it, "e")
+    for v_pp, v_ref in zip(e_pp.split(":")[1:], e_ref.split(":")[1:]):
+        np.testing.assert_allclose(float(v_pp.split("\t")[0]),
+                                   float(v_ref.split("\t")[0]), rtol=1e-3)
