@@ -49,6 +49,27 @@ def _collect_nodes(res, needed):
     return nodes
 
 
+def _chain_scan(one, length):
+    """Wrap a modal one-step body into a ``length``-step lax.scan chain
+    (update_chain): the (params, opt_state, net_state, rng) carry threads
+    through; accum is stubbed (no update_period in chains), per-step node
+    captures are discarded (DCE'd), and the per-step losses stack.
+    ``one``: (params, opt_state, net_state, accum, data, label, mask,
+    rng, sched) -> (params, opt_state, net_state, accum, loss, nodes,
+    rng) — the shared signature of the std/sp/pp one-step bodies."""
+    def step(params, opt_state, net_state, data, label, mask, rng, sched):
+        def sbody(carry, _):
+            p, o, s, r = carry
+            p, o, s, _a, loss, _n, r = one(
+                p, o, s, {}, data, label, mask, r, sched)
+            return (p, o, s, r), loss
+        (params, opt_state, net_state, rng), losses = jax.lax.scan(
+            sbody, (params, opt_state, net_state, rng), None,
+            length=length)
+        return params, opt_state, net_state, losses, rng
+    return step
+
+
 def _apply_grads(opt, period, do_update, params, opt_state, accum, grads,
                  sched):
     """Gradient accumulation (update_period) + optimizer step — shared by
@@ -127,6 +148,7 @@ class Trainer:
         self._last_loss = None
         self._sched_cache = None
         self._mask_cache = None
+        self._sp_label_cache = None
         self._rng_key = None
         self._norm_fn = None
         # one-step deferred train-metric fetch: device->host reads of step
@@ -457,31 +479,40 @@ class Trainer:
         out = [jax.device_put(data, self.mesh.named(
             P(self.mesh.data_axis, None, None, self.mesh.seq_axis)))]
         if label is not None:
-            sh = self.mesh.named(P(self.mesh.data_axis, self.mesh.seq_axis))
-            label = np.asarray(label)
-            out.append(tuple(
-                jax.device_put(np.ascontiguousarray(label[:, a:b]), sh)
-                for a, b in self.graph.label_range))
+            out.append(self._shard_seq_label(label))
         return out if len(out) != 1 else out[0]
 
-    def _make_sp_train_step(self, do_update: bool):
+    def _shard_seq_label(self, label):
+        """Per-label_vec-range tuple of (data, seq)-sharded label slices —
+        the form every sp step consumes (see _shard_seq_batch)."""
+        from jax.sharding import PartitionSpec as P
+        sh = self.mesh.named(P(self.mesh.data_axis, self.mesh.seq_axis))
+        label = np.asarray(label)
+        return tuple(
+            jax.device_put(np.ascontiguousarray(label[:, a:b]), sh)
+            for a, b in self.graph.label_range)
+
+    def _make_sp_train_step(self, do_update: bool, chain: int = 0):
         """Sequence-parallel train step: the whole step body runs under
         shard_map over the ('data','seq') mesh; mha layers take the ring
         path, gradients of replicated params are psum'd automatically by
         shard_map's transpose, and the loss is averaged across shards;
         the shard indices fold into the dropout rng so masks are
-        independent per shard."""
+        independent per shard. ``chain`` > 0: lax.scan ``chain`` steps
+        over one fixed batch INSIDE the shard_map (update_chain — one
+        dispatch, no metric capture), returning the per-step loss
+        vector."""
         from jax.sharding import PartitionSpec as P
         net, opt, period = self.net, self.optimizer, self.update_period
         seq_axis, data_axis = self.mesh.seq_axis, self.mesh.data_axis
         rep = P()
-        needed = self._needed_nodes()
+        needed = [] if chain else self._needed_nodes()
         capture = bool(needed)
 
         ranges = list(self.graph.label_range)
 
-        def step(params, opt_state, net_state, accum, data, label, mask,
-                 rng, sched):
+        def one(params, opt_state, net_state, accum, data, label, mask,
+                rng, sched):
             # decorrelate dropout across shards: fold both shard indices
             # into the key (a replicated key would repeat masks per shard)
             rng_l = jax.random.fold_in(
@@ -511,21 +542,32 @@ class Trainer:
             return (params, opt_state, new_state, accum, loss, nodes,
                     jax.random.fold_in(rng, 1))
 
+        step = _chain_scan(one, chain) if chain else one
         node_spec = P(data_axis, seq_axis, None, None)
         nodes_spec = {k: node_spec for k in [_TOP] + needed}
         # PARTIAL-MANUAL shard_map: only ('data','seq') go manual; the
         # 'model' axis stays automatic, so GSPMD keeps tensor/expert
         # parallelism (per-layer param_pspecs) working INSIDE the
         # sequence-parallel step — this is what makes sp x tp compose
-        wrapped = jax.shard_map(
-            step, mesh=self.mesh.mesh,
-            in_specs=(rep, rep, rep, rep,
-                      P(data_axis, None, None, seq_axis),
-                      tuple(P(data_axis, seq_axis) for _ in ranges),
-                      P(data_axis), rep, rep),
-            out_specs=(rep, rep, rep, rep, rep, nodes_spec, rep),
-            axis_names={data_axis, seq_axis})
-        return jax.jit(wrapped, donate_argnums=(0, 1, 2, 3))
+        data_spec = P(data_axis, None, None, seq_axis)
+        lspec = tuple(P(data_axis, seq_axis) for _ in ranges)
+        if chain:
+            wrapped = jax.shard_map(
+                step, mesh=self.mesh.mesh,
+                in_specs=(rep, rep, rep, data_spec, lspec,
+                          P(data_axis), rep, rep),
+                out_specs=(rep, rep, rep, rep, rep),
+                axis_names={data_axis, seq_axis})
+        else:
+            wrapped = jax.shard_map(
+                step, mesh=self.mesh.mesh,
+                in_specs=(rep, rep, rep, rep, data_spec, lspec,
+                          P(data_axis), rep, rep),
+                out_specs=(rep, rep, rep, rep, rep, nodes_spec, rep),
+                axis_names={data_axis, seq_axis})
+        # chain: arg 3 is the batch — donate only the carried state
+        return jax.jit(wrapped,
+                       donate_argnums=(0, 1, 2) if chain else (0, 1, 2, 3))
 
     def _pp_row_specs(self, out_sd, node_sds):
         """out_specs for the pp steps' nodes dict: batch-sharded rows
@@ -896,7 +938,8 @@ class Trainer:
                 out[layer.name] = layer.bn_momentum
         return out
 
-    def _make_pp_train_step(self, do_update: bool, data_shape):
+    def _make_pp_train_step(self, do_update: bool, data_shape,
+                            chain: int = 0):
         """Pipeline-parallel train step. The WHOLE step body runs under
         one FULLY-MANUAL shard_map over ('data','pipe','model'). Tensor
         parallelism inside the stages is MANUAL — weight slices +
@@ -908,7 +951,10 @@ class Trainer:
         batch_norm layers normalize with microbatch-local statistics
         (the reference's own per-GPU BN semantics,
         batch_norm_layer-inl.hpp) while their running stats get one exact
-        global-batch update merged across microbatches AND data shards."""
+        global-batch update merged across microbatches AND data shards.
+        ``chain`` > 0: lax.scan ``chain`` steps over one fixed batch
+        inside the shard_map (update_chain — one dispatch, no metric
+        capture), returning the per-step loss vector."""
         from jax.sharding import PartitionSpec as P
         net, opt, period = self.net, self.optimizer, self.update_period
         pipe_axis, data_axis = self.mesh.pipe_axis, self.mesh.data_axis
@@ -916,7 +962,8 @@ class Trainer:
         sp, seq_axis = self._sp, self.mesh.seq_axis
         mean_axes = (data_axis, model_axis) + ((seq_axis,) if sp > 1
                                                else ())
-        needed = tuple(self._needed_nodes()) if self.eval_train else ()
+        needed = (tuple(self._needed_nodes())
+                  if self.eval_train and not chain else ())
         # the accumulator node (the FINAL layer's output, post loss tail)
         # already arrives via the schedule's out accumulator — a metric
         # bound to its NAME aliases it instead of banking a copy. Note
@@ -943,8 +990,8 @@ class Trainer:
         gather, scatter = self._pp_gather_fn(pspecs), \
             self._pp_scatter_fn(pspecs)
 
-        def step(params, opt_state, net_state, accum, data, label, mask,
-                 rng, sched):
+        def one(params, opt_state, net_state, accum, data, label, mask,
+                rng, sched):
             full = gather(params)
 
             def loss_fn(p):
@@ -1002,6 +1049,7 @@ class Trainer:
             return (params, opt_state, new_state, accum, loss, nodes,
                     jax.random.fold_in(rng, 1))
 
+        step = _chain_scan(one, chain) if chain else one
         if sp > 1:
             ds = P(data_axis, *([None] * (len(data_shape) - 2)), seq_axis)
             lspec = tuple(P(data_axis, seq_axis)
@@ -1011,6 +1059,14 @@ class Trainer:
             ds = P(data_axis, *([None] * (len(data_shape) - 1)))
             lspec = P(data_axis)
             axes = {data_axis, pipe_axis, model_axis}
+        if chain:
+            wrapped = jax.shard_map(
+                step, mesh=self.mesh.mesh,
+                in_specs=(pspecs, opt_pspecs, rep, ds, lspec,
+                          P(data_axis), rep, rep),
+                out_specs=(pspecs, opt_pspecs, rep, rep, rep),
+                axis_names=axes)
+            return jax.jit(wrapped, donate_argnums=(0, 1, 2))
         nodes_spec = self._pp_row_specs(out_sd, node_sds)
         for name in needed:
             if name == top_name:
@@ -1077,13 +1133,21 @@ class Trainer:
                                 axis_names=axes)
         return jax.jit(wrapped)
 
-    def _make_train_step(self, do_update: bool):
+    def _make_train_step(self, do_update: bool, chain: int = 0):
+        """Standard (GSPMD dp/tp) train step. ``chain`` > 0: k steps on
+        one fixed batch fused into ONE dispatch via the shared
+        _chain_scan wrapper (update_chain; no metric capture). Exists
+        because per-step dispatch over a remote-device link measures the
+        link, not the chip (the reference's per-batch Update never had
+        this problem — its driver sat on the PCIe bus): bench.py times a
+        k-chain and divides. The rng chains per-step exactly as
+        ``update`` does."""
         net, opt, period = self.net, self.optimizer, self.update_period
-        needed = self._needed_nodes()
+        needed = [] if chain else self._needed_nodes()
         capture = bool(needed)
 
-        def step(params, opt_state, net_state, accum, data, label, mask,
-                 extra, rng, sched):
+        def one(params, opt_state, net_state, accum, data, label, mask,
+                extra, rng, sched):
             def loss_fn(p):
                 res = net.apply(p, net_state, data, label, mask,
                                 extra_data=extra, rng=rng, train=True,
@@ -1098,64 +1162,55 @@ class Trainer:
             return (params, opt_state, new_state, accum, loss, nodes,
                     jax.random.fold_in(rng, 1))
 
-        return jax.jit(step, donate_argnums=(0, 1, 2, 3))
-
-    def _make_chained_train_step(self, k: int):
-        """``k`` full train steps in ONE dispatch (lax.scan over the same
-        step body _make_train_step jits singly). Exists because per-step
-        dispatch over a remote-device link measures the link, not the chip
-        (the reference's per-batch Update never had this problem — its
-        driver sat on the PCIe bus): bench.py times a k-chain and divides.
-        Also usable for real training on a fixed accumulation window. The
-        batch is fixed across the k steps; rng chains per-step exactly as
-        ``update`` does."""
-        net, opt = self.net, self.optimizer
-
-        def step(params, opt_state, net_state, data, label, mask, extra,
-                 rng, sched):
-            def body(carry, _):
-                params, opt_state, net_state, rng = carry
-                def loss_fn(p):
-                    res = net.apply(p, net_state, data, label, mask,
-                                    extra_data=extra, rng=rng, train=True,
-                                    capture_nodes=False)
-                    return res.loss, res.state
-                (loss, new_state), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True)(params)
-                params, opt_state = opt.update(params, grads, opt_state,
-                                               sched)
-                return (params, opt_state, new_state,
-                        jax.random.fold_in(rng, 1)), loss
-            (params, opt_state, net_state, rng), losses = jax.lax.scan(
-                body, (params, opt_state, net_state, rng), None, length=k)
-            return params, opt_state, net_state, losses, rng
-
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        if chain:
+            def step(params, opt_state, net_state, data, label, mask,
+                     extra, rng, sched):
+                bound = lambda p, o, s, a, d, l, m, r, sc: one(
+                    p, o, s, a, d, l, m, extra, r, sc)
+                return _chain_scan(bound, chain)(
+                    params, opt_state, net_state, data, label, mask,
+                    rng, sched)
+            return jax.jit(step, donate_argnums=(0, 1, 2))
+        return jax.jit(one, donate_argnums=(0, 1, 2, 3))
 
     def update_chain(self, batch: DataBatch, k: int) -> "jax.Array":
         """Run ``k`` train steps on one (fixed) batch in a single device
         dispatch; returns the per-step loss vector (device array — fetch
-        to sync). Standard mode only: chained stepping composes with
-        dp/tp shardings but not with the pp/sp custom schedules, gradient
-        accumulation, or train-metric capture. LR/momentum schedules are
-        evaluated once at chain start and held for the k steps."""
+        to sync). Works in std, sp, and pp modes (the scan wraps the
+        modal step body inside its shard_map); composes with dp/tp
+        shardings. Not supported: gradient accumulation
+        (``update_period``) and train-metric capture. LR/momentum
+        schedules are evaluated once at chain start and held for the k
+        steps."""
         assert self.params is not None, "call init_model() first"
-        if self._pp > 1 or self._sp > 1 or self.update_period > 1:
-            raise ValueError("update_chain: std mode only (no pp/sp/"
-                             "update_period)")
-        key = ("chain", k)
+        if k <= 0:
+            raise ValueError(f"update_chain: k must be >= 1, got {k}")
+        if self.update_period > 1:
+            raise ValueError("update_chain: update_period accumulation "
+                             "does not chain")
+        mode = "pp" if self._pp > 1 else "sp" if self._sp > 1 else "std"
+        key = ("chain", k, mode,
+               np.shape(batch.data) if mode == "pp" else None)
         if key not in self._train_step_fns:
-            self._train_step_fns[key] = self._make_chained_train_step(k)
+            if mode == "pp":
+                fn = self._make_pp_train_step(True, np.shape(batch.data),
+                                              chain=k)
+            elif mode == "sp":
+                fn = self._make_sp_train_step(True, chain=k)
+            else:
+                fn = self._make_train_step(True, chain=k)
+            self._train_step_fns[key] = fn
         mask = self._mask(batch)
         if self._rng_key is None:
             self._rng_key = jax.random.fold_in(self._base_key,
                                                self._step_count)
         staged = self.stage_batch(batch)
+        args = (self.params, self.opt_state, self.net_state, staged.data,
+                staged.label, mask) \
+            + ((tuple(staged.extra_data),) if mode == "std" else ()) \
+            + (self._rng_key, self._sched_scalars())
         (self.params, self.opt_state, self.net_state, losses,
-         self._rng_key) = self._train_step_fns[key](
-             self.params, self.opt_state, self.net_state, staged.data,
-             staged.label, mask, tuple(staged.extra_data), self._rng_key,
-             self._sched_scalars())
+         self._rng_key) = self._train_step_fns[key](*args)
         self._last_loss = losses[-1]
         self._step_count += k
         self.sample_counter = 0
@@ -1209,7 +1264,27 @@ class Trainer:
         label/extra arrays (metrics read labels host-side), so uploading
         them would waste the bandwidth the prefetch exists to hide."""
         if isinstance(batch.data, jax.Array):
-            return batch                              # already staged
+            # already staged — but a mode-unaware caller (e.g. bench's
+            # device-resident batches) may have staged the label as one
+            # array where the sp steps need the per-label_vec-range tuple
+            # of seq-sharded slices; restage just the label, cached per
+            # caller-held label object (one host round-trip total)
+            if (self._sp > 1 and not for_eval and batch.label is not None
+                    and not isinstance(batch.label, tuple)):
+                key = id(batch.label)
+                if self._sp_label_cache is None \
+                        or self._sp_label_cache[0] != key:
+                    host = np.asarray(batch.label)
+                    self._sp_label_cache = (
+                        key, self._shard_seq_label(host), host)
+                _, sliced, host = self._sp_label_cache
+                batch = DataBatch(
+                    data=batch.data, label=sliced,
+                    num_batch_padd=batch.num_batch_padd,
+                    inst_index=batch.inst_index,
+                    extra_data=batch.extra_data, norm=batch.norm,
+                    host_label=host)
+            return batch
         if for_eval:
             data = (self._shard_seq_batch(batch.data) if self._sp > 1
                     else self.mesh.shard_batch(batch.data))

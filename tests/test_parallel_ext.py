@@ -810,3 +810,28 @@ def test_pipeline_aux_loss_head_matches_unsharded():
     for v_pp, v_ref in zip(e_pp.split(":")[1:], e_ref.split(":")[1:]):
         np.testing.assert_allclose(float(v_pp.split("\t")[0]),
                                    float(v_ref.split("\t")[0]), rtol=1e-3)
+
+
+def test_pp_update_chain_matches_sequential_updates():
+    """update_chain under pipeline_parallel: k steps scanned inside the
+    pp shard_map — GPipe ring, FSDP gather/update, and the rng chain all
+    ride the scan carry — must reproduce k sequential update() calls."""
+    cfg = parse_config_string(PP_MLP_CFG)
+    tr_c = Trainer(cfg + [("pipeline_microbatch", "4")],
+                   mesh_ctx=_pp_mesh(pp=2, dp=2))
+    tr_s = Trainer(cfg + [("pipeline_microbatch", "4")],
+                   mesh_ctx=_pp_mesh(pp=2, dp=2))
+    tr_c.init_model()
+    tr_s.init_model()
+    it = create_iterator(parse_config_string(PP_ITER))
+    b = it.next()
+    losses = np.asarray(tr_c.update_chain(b, 3))
+    seq = []
+    for _ in range(3):
+        tr_s.update(b)
+        seq.append(float(tr_s.last_loss))
+    np.testing.assert_allclose(losses, seq, rtol=1e-5)
+    for layer in ("fc1", "fc3"):
+        np.testing.assert_allclose(
+            tr_c.get_weight(layer, "wmat"),
+            tr_s.get_weight(layer, "wmat"), rtol=1e-5, atol=1e-6)

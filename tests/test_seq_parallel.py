@@ -292,3 +292,39 @@ def test_sp_moe_expert_capacity_sharded():
                      label, tr._mask(b), jax.random.PRNGKey(0),
                      tr._sched_scalars()).as_text()
     assert "reduce_scatter" in txt or "reduce-scatter" in txt
+
+
+def test_sp_update_chain_matches_sequential_updates():
+    """update_chain under seq_parallel: k steps scanned inside the sp
+    shard_map (one dispatch) must reproduce k sequential update() calls
+    — same rng chain, schedules held (constant here)."""
+    tr_c = _trainer(4)
+    tr_s = _trainer(4)
+    it = create_iterator(parse_config_string(ITER_CFG))
+    b = next(iter(it))
+    losses = np.asarray(tr_c.update_chain(b, 3))
+    seq = []
+    for _ in range(3):
+        tr_s.update(b)
+        seq.append(float(tr_s.last_loss))
+    np.testing.assert_allclose(losses, seq, rtol=1e-5)
+    np.testing.assert_allclose(tr_c.get_weight("attn1", "q.wmat"),
+                               tr_s.get_weight("attn1", "q.wmat"),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_sp_update_chain_accepts_prestaged_batch():
+    """bench.py holds device-resident batches staged mode-unaware
+    (mesh.shard_batch on data AND label); stage_batch must restage the
+    label into the sp per-range tuple form instead of tripping the
+    chain shard_map's pytree specs."""
+    from cxxnet_tpu.io.data import DataBatch
+    tr_c = _trainer(4)
+    tr_h = _trainer(4)
+    it = create_iterator(parse_config_string(ITER_CFG))
+    b = next(iter(it))
+    staged = DataBatch(data=tr_c.mesh.shard_batch(np.asarray(b.data)),
+                       label=tr_c.mesh.shard_batch(np.asarray(b.label)))
+    l_dev = np.asarray(tr_c.update_chain(staged, 2))
+    l_host = np.asarray(tr_h.update_chain(b, 2))
+    np.testing.assert_allclose(l_dev, l_host, rtol=1e-5)
