@@ -1271,12 +1271,14 @@ class Trainer:
             # caller-held label object (one host round-trip total)
             if (self._sp > 1 and not for_eval and batch.label is not None
                     and not isinstance(batch.label, tuple)):
-                key = id(batch.label)
+                # cache holds the label OBJECT (identity key + keep-alive:
+                # a bare id() could be reused by a new array after GC and
+                # silently serve stale slices)
                 if self._sp_label_cache is None \
-                        or self._sp_label_cache[0] != key:
+                        or self._sp_label_cache[0] is not batch.label:
                     host = np.asarray(batch.label)
                     self._sp_label_cache = (
-                        key, self._shard_seq_label(host), host)
+                        batch.label, self._shard_seq_label(host), host)
                 _, sliced, host = self._sp_label_cache
                 batch = DataBatch(
                     data=batch.data, label=sliced,

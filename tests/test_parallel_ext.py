@@ -835,3 +835,45 @@ def test_pp_update_chain_matches_sequential_updates():
         np.testing.assert_allclose(
             tr_c.get_weight(layer, "wmat"),
             tr_s.get_weight(layer, "wmat"), rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_sp_aux_loss_head_matches_unsharded():
+    """Aux loss heads under pp x sp: the stage-0 aux projection's output
+    rides the (seq-sharded) carried register to the last stage, the tail
+    runs both lmloss heads on label slices, and tail-written captures
+    ('lg' is rewritten by its loss; 'auxlg' is the accumulator node)
+    extract identically to the unsharded run."""
+    aux = PP_SP_LM_CFG.replace(
+        "layer[+1:n2] = layernorm:ln2",
+        f"layer[r1->auxlg] = seqfc:aux_head\n  nhidden = {V}\n"
+        "layer[r1->n2] = layernorm:ln2").replace(
+        "layer[+0] = lmloss",
+        "layer[lg->lg] = lmloss\nlayer[auxlg->auxlg] = lmloss\n"
+        "  grad_scale = 0.3")
+    cfg = parse_config_string(aux)
+    devs = jax.devices()[:4]
+    tr_pp = Trainer(cfg + [("pipeline_microbatch", "1")],
+                    mesh_ctx=make_mesh_context(devices=devs,
+                                               pipeline_parallel=2,
+                                               seq_parallel=2))
+    tr_ref = Trainer(cfg, mesh_ctx=_pp_mesh(pp=1, dp=1))
+    tr_pp.init_model()
+    tr_ref.init_model()
+    it = create_iterator(parse_config_string(ITER_CFG))
+    losses_pp, losses_ref = [], []
+    for b in it:
+        tr_pp.update(b)
+        losses_pp.append(tr_pp.last_loss)
+    for b in it:
+        tr_ref.update(b)
+        losses_ref.append(tr_ref.last_loss)
+    np.testing.assert_allclose(losses_pp, losses_ref, rtol=1e-3)
+    np.testing.assert_allclose(
+        tr_pp.get_weight("aux_head", "wmat"),
+        tr_ref.get_weight("aux_head", "wmat"), rtol=1e-3, atol=1e-5)
+    it.before_first()
+    b0 = it.next()
+    for node in ("lg", "auxlg"):
+        np.testing.assert_allclose(
+            tr_pp.extract_feature(b0, node),
+            tr_ref.extract_feature(b0, node), rtol=1e-3, atol=1e-5)

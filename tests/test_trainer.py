@@ -450,3 +450,27 @@ def test_update_chain_refuses_special_modes(mesh8):
     batch = next(iter(synth_iter()))
     with pytest.raises(ValueError):
         tr.update_chain(batch, 2)
+
+
+def test_mesh_axis_nesting_keeps_fast_axes_innermost():
+    """Multi-host placement contract (doc/multichip.md): the mesh lays
+    out (data, pipe, seq, model) with data OUTERMOST over the
+    process-major jax.devices() order, so pipe/seq/model collective
+    groups stay within one host's contiguous devices (ICI) and only the
+    data axis spans hosts (DCN)."""
+    import jax
+    from cxxnet_tpu.parallel import make_mesh_context
+    devs = jax.devices()
+    ctx = make_mesh_context(devices=devs, pipeline_parallel=2,
+                            seq_parallel=2, model_parallel=2)
+    arr = ctx.mesh.devices                      # (data=1, 2, 2, 2)
+    flat = [d.id for d in arr.ravel()]
+    assert flat == [d.id for d in devs], (
+        "mesh must preserve device order data-major")
+    # every non-data group is a contiguous id run within one data block
+    n_inner = 2 * 2 * 2
+    for i, d in enumerate(arr.ravel()):
+        assert d.id == devs[0].id + i
+    # model groups: innermost pairs; pipe groups: stride-4 within a block
+    assert arr[0, 0, 0, 0].id + 1 == arr[0, 0, 0, 1].id
+    assert arr[0, 1, 0, 0].id - arr[0, 0, 0, 0].id == n_inner // 2
