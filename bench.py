@@ -56,6 +56,12 @@ _CHIP_PEAKS = [
 ]
 
 
+def _timed(fn):
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
 def chip_peaks(device):
     kind = getattr(device, "device_kind", "").lower()
     for key, peaks in _CHIP_PEAKS:
@@ -125,6 +131,7 @@ def compute_bench(tr, image, classes, batch, steps, ref_cost_fn=None):
     compiles. ``ref_cost_fn`` (multi-chip runs): returns the single-chip
     cost dict used as per-chip truth for the MFU/roofline math."""
     import jax
+    import jax.numpy as jnp
     import numpy as np
     from cxxnet_tpu.io.data import DataBatch
 
@@ -147,22 +154,50 @@ def compute_bench(tr, image, classes, batch, steps, ref_cost_fn=None):
         probe_k = max(2, min(8, steps))
         first_losses = tr.update_chain(b, probe_k)
         loss_start = float(first_losses[0])
-        t0 = time.perf_counter()
-        float(tr.update_chain(b, probe_k)[-1])
-        est = (time.perf_counter() - t0) / probe_k
-        k2 = int(max(8, min(1200, 2.0 / max(est, 1e-5))))
-        k1 = max(2, k2 // 8)
-        # warm both chain lengths (compile + donation layout settle)
-        float(tr.update_chain(b, k1)[-1])
-        float(tr.update_chain(b, k2)[-1])
-        times = {k1: [], k2: []}
+        # size the timed chains from a geometric probe ladder: quadruple
+        # k until one chain's wall time clearly exceeds the dispatch+
+        # fetch floor (~100-130 ms over the remote tunnel), then estimate
+        # the per-step time from the LAST TWO rungs' slope. A single
+        # probe divided by k inflates the estimate by RTT/k and shrinks
+        # the window below the jitter floor for sub-ms models (the
+        # round-4 bowl fallback — its real step is ~0.6 ms, and an
+        # RTT-sized window made the slope sign-flip on jitter).
+        k_prev, t_prev = probe_k, min(
+            _timed(lambda: float(tr.update_chain(b, probe_k)[-1]))
+            for _ in range(2))
+        k_cur, t_cur = k_prev, t_prev
+        while t_cur < 0.8 and k_cur < 4096:
+            k_prev, t_prev = k_cur, t_cur
+            k_cur = k_cur * 4
+            float(tr.update_chain(b, k_cur)[-1])         # compile + warm
+            t_cur = min(
+                _timed(lambda: float(tr.update_chain(b, k_cur)[-1]))
+                for _ in range(2))
+        if k_cur == k_prev:
+            # ladder never iterated: the first probe already exceeded the
+            # floor (slow model, >=100 ms/step) — RTT is negligible there,
+            # a plain per-step division is accurate
+            est = max(t_cur / k_cur, 1e-5)
+        else:
+            est = max((t_cur - t_prev) / (k_cur - k_prev), 1e-5)
+        k2 = int(max(8, min(6000, 2.0 / est)))
         loss_end = None
-        for k in (k1, k2, k1, k2, k1, k2):
-            t0 = time.perf_counter()
-            losses = tr.update_chain(b, k)
-            loss_end = float(losses[-1])     # value sync ends the timing
-            times[k].append(time.perf_counter() - t0)
-        dt_step = (min(times[k2]) - min(times[k1])) / (k2 - k1)
+        for attempt in range(2):
+            k1 = max(2, k2 // 8)
+            # warm both chain lengths (compile + donation layout settle)
+            float(tr.update_chain(b, k1)[-1])
+            float(tr.update_chain(b, k2)[-1])
+            times = {k1: [], k2: []}
+            for k in (k1, k2, k1, k2, k1, k2):
+                t0 = time.perf_counter()
+                losses = tr.update_chain(b, k)
+                loss_end = float(losses[-1])  # value sync ends the timing
+                times[k].append(time.perf_counter() - t0)
+            dt_step = (min(times[k2]) - min(times[k1])) / (k2 - k1)
+            if dt_step > 0:
+                break
+            # jitter swamped the window: one retry with a 2x chain
+            k2 = min(12000, k2 * 2)
         if dt_step <= 0:                     # jitter swamped a tiny model
             raise RuntimeError(
                 f"non-positive slope ({dt_step:.2e}s) — link jitter "

@@ -257,8 +257,15 @@ class InsanityLayer(Layer):
     cumulative step counter (a quadratic-drift bug); here annealing is a
     clean linear interpolation of (lb, ub) toward their midpoint over
     [calm_start, calm_end] updates, tracked in layer state.
+
+    Pipelines (``pp_state_tick``): microbatches read the step counter
+    frozen at its start-of-step value — exactly the unsharded step's
+    pre-increment semantics — and the trainer advances it ONCE per
+    training step after the ring (``state_tick``), not once per
+    microbatch.
     """
     has_state = True
+    pp_state_tick = True
 
     def set_param(self, name, val):
         if name == "lb":
@@ -282,6 +289,11 @@ class InsanityLayer(Layer):
     def init_state(self, in_shapes):
         return {"step": jnp.zeros((), jnp.int32)}
 
+    def state_tick(self, state):
+        """One training step's deterministic state advance — applied by
+        the pipeline trainer once per step after the ring."""
+        return {"step": state["step"] + 1}
+
     def _bounds(self, step):
         if self.calm_end <= self.calm_start:
             return self.lb, self.ub
@@ -297,7 +309,15 @@ class InsanityLayer(Layer):
             slope = jax.random.uniform(ctx.rng, x.shape, x.dtype) * (ub - lb) + lb
             new_state = {"step": state["step"] + 1}
         else:
-            slope = (ub - lb) / (jnp.log(ub) - jnp.log(lb))
+            # eval divisor 1/E[1/s] = (ub-lb)/(log ub - log lb) — guard
+            # the fully-annealed lb == ub case (linear annealing reaches
+            # it exactly; the reference's eval formula is 0/0 there too,
+            # insanity_layer-inl.hpp:71) with the analytic limit lb
+            lb_, ub_ = jnp.float32(lb), jnp.float32(ub)
+            denom = jnp.log(ub_) - jnp.log(lb_)
+            slope = jnp.where(denom < 1e-8, 0.5 * (lb_ + ub_),
+                              (ub_ - lb_) / jnp.maximum(denom, 1e-8))
+            slope = slope.astype(x.dtype)
             new_state = state
         return [_xelu(x, slope)], new_state
 

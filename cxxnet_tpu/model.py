@@ -239,13 +239,16 @@ class Network:
                 if ((layer.has_state or layer.init_state(
                         self._in_shapes_of[li]))
                         and not getattr(layer, "pp_batch_stats", False)
-                        and not getattr(layer, "pp_aux_loss", False)):
+                        and not getattr(layer, "pp_aux_loss", False)
+                        and not getattr(layer, "pp_state_tick", False)):
                     # batch_norm is admitted: its microbatch moments ride
                     # the schedule's stat sink and merge after the ring.
                     # moe is admitted: its _aux_loss rides the schedule's
                     # per-stage scalar accumulator (differentiated).
-                    # Remaining stateful layers (e.g. insanity's annealing
-                    # counter) cannot pipeline.
+                    # insanity is admitted: its annealing counter is read
+                    # frozen by the microbatches and ticked once per step
+                    # by the trainer (pp_state_tick). Remaining stateful
+                    # layers (pairtest's divergence log) cannot pipeline.
                     raise ValueError(
                         f"pipeline_parallel: stateful layer "
                         f"{spec.name!r} ({spec.type}) is not supported in "

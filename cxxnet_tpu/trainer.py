@@ -976,6 +976,14 @@ class Trainer:
         pipeline, out_sd, tp_plan, node_sds = self._pp_pipeline_fn(
             data_shape, train=True, capture=captured)
         bn_ema = self._pp_bn_momenta()
+        # per-step deterministic state advances (insanity's annealing
+        # counter): microbatches read the counter frozen, the trainer
+        # ticks it ONCE here after the ring
+        tick_layers = {
+            layer.name: layer
+            for spec, layer in zip(self.graph.layers, self.net.layers)
+            if not spec.is_shared
+            and getattr(layer, "pp_state_tick", False)}
         M = self._pp_microbatch
         rep = P()
         # at-rest FSDP over 'pipe': sharded leaves enter as local shards,
@@ -1043,6 +1051,11 @@ class Trainer:
                         "running_var": st["running_var"] * mom
                         + var * (1 - mom),
                     }
+            if tick_layers:
+                if new_state is net_state:
+                    new_state = dict(net_state)
+                for name, layer in tick_layers.items():
+                    new_state[name] = layer.state_tick(net_state[name])
             params, opt_state, accum = _apply_grads(
                 opt, period, do_update, params, opt_state, accum, grads,
                 sched)

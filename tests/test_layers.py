@@ -455,3 +455,40 @@ def test_conv_space_to_depth_matches_direct(cin, hw, k, s, p):
         np.testing.assert_allclose(np.asarray(g_s2d["cv"][tag]),
                                    np.asarray(g_dir["cv"][tag]),
                                    rtol=1e-3, atol=1e-4)
+
+
+def test_insanity_eval_slope_finite_when_fully_annealed():
+    """The eval divisor (ub-lb)/(log ub - log lb) is 0/0 once annealing
+    reaches lb == ub (the reference's formula has the same hazard,
+    insanity_layer-inl.hpp:71); the guard must produce the analytic
+    limit — xelu with the midpoint slope — not NaN."""
+    import jax
+    import jax.numpy as jnp
+    from cxxnet_tpu.config import parse_config_string
+    from cxxnet_tpu.graph import build_graph
+    from cxxnet_tpu.layers import create_layer
+    from cxxnet_tpu.layers.base import ApplyCtx
+    cfg = parse_config_string("""
+netconfig=start
+layer[+1:a] = insanity:ins
+  lb = 4
+  ub = 8
+  calm_start = 0
+  calm_end = 4
+netconfig=end
+input_shape = 1,1,8
+batch_size = 2
+""")
+    g = build_graph(cfg)
+    layer = create_layer(g.layers[0], g.defcfg)
+    layer.infer_shapes([(1, 1, 8)])
+    x = jnp.asarray(np.linspace(-2, 2, 16).reshape(2, 1, 1, 8),
+                    jnp.float32)
+    # state past calm_end: lb == ub == 6 exactly
+    (out,), _ = layer.apply({}, {"step": jnp.int32(10)}, [x],
+                            ApplyCtx(train=False,
+                                     rng=jax.random.PRNGKey(0)))
+    assert np.all(np.isfinite(np.asarray(out)))
+    expect = np.where(np.asarray(x) > 0, np.asarray(x),
+                      np.asarray(x) / 6.0)
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5)

@@ -163,13 +163,42 @@ def test_pipeline_cross_stage_skip_matches_unsharded():
 
 
 def test_pipeline_rejects_stateful_body():
-    """Stateful layers whose state the schedule cannot thread (insanity's
-    annealing counter) are refused in a pipeline body. (BN and MoE are
-    admitted — their moments/aux-loss ride the schedule's sinks.)"""
+    """Stateful layers whose state the schedule cannot thread (pairtest's
+    divergence log) are refused in a pipeline body. (BN, MoE, and
+    insanity are admitted — moments/aux-loss ride the schedule's sinks,
+    the anneal counter ticks once per step post-ring.)"""
     bad = PP_MLP_CFG.replace("layer[+1:a1] = relu",
-                             "layer[+1:a1] = insanity:ins")
+                             "layer[+1:a1] = pairtest-relu-sigmoid:pt")
     with pytest.raises(ValueError, match="stateful"):
         Trainer(parse_config_string(bad), mesh_ctx=_pp_mesh(pp=2, dp=2))
+
+
+def test_pipeline_insanity_anneal_ticks_once_per_step():
+    """insanity in a pipeline body: microbatches read the annealing
+    counter frozen at its start-of-step value and the trainer ticks it
+    ONCE per training step (not once per microbatch); eval (deterministic
+    slope) matches the unsharded run at init."""
+    ins = PP_MLP_CFG.replace(
+        "layer[+1:a1] = relu",
+        "layer[+1:a1] = insanity:ins\n  lb = 4\n  ub = 8\n"
+        "  calm_start = 0\n  calm_end = 8")
+    cfg = parse_config_string(ins)
+    tr_pp = Trainer(cfg + [("pipeline_microbatch", "4")],
+                    mesh_ctx=_pp_mesh(pp=2, dp=2))
+    tr_ref = Trainer(cfg, mesh_ctx=_pp_mesh(pp=1, dp=1))
+    tr_pp.init_model()
+    tr_ref.init_model()
+    it = create_iterator(parse_config_string(PP_ITER))
+    b0 = it.next()
+    np.testing.assert_allclose(
+        tr_pp.extract_feature(b0, "out"),
+        tr_ref.extract_feature(b0, "out"), rtol=1e-4, atol=1e-6)
+    for _ in range(3):
+        tr_pp.update(b0)
+        tr_ref.update(b0)
+    assert int(tr_pp.get_state("ins", "step")) == 3
+    assert int(tr_ref.get_state("ins", "step")) == 3
+    assert np.isfinite(float(tr_pp.last_loss))
 
 
 def test_pipeline_moe_lm_matches_unsharded():
