@@ -131,7 +131,6 @@ def compute_bench(tr, image, classes, batch, steps, ref_cost_fn=None):
     compiles. ``ref_cost_fn`` (multi-chip runs): returns the single-chip
     cost dict used as per-chip truth for the MFU/roofline math."""
     import jax
-    import jax.numpy as jnp
     import numpy as np
     from cxxnet_tpu.io.data import DataBatch
 

@@ -351,14 +351,19 @@ class ImageRecordIterator(DataIter):
     def _process_one(self, payload: bytes, item_counter: int):
         rec = ImageRecord.unpack(payload)
         rng = np.random.RandomState(self._hash_seed(item_counter))
-        img = self.augmenter.process(self._decode(rec), rng)
         if self.aug.device_normalize:
             # defer mean/divideby/scale to the device (trainer applies them
             # after a 4x smaller uint8 host->device copy); crop/mirror
-            # augmentation keeps exact uint8 pixels, float-producing
-            # augmentations (affine/contrast) round to the nearest LSB
-            img = np.clip(np.rint(img), 0.0, 255.0).astype(np.uint8)
+            # stay pure uint8 slicing (process_u8 — no float round-trip),
+            # float-producing augmentations (affine/contrast/upscale)
+            # take the float path and round to the nearest LSB
+            decoded = self._decode(rec)
+            img = self.augmenter.process_u8(decoded, rng)
+            if img is None:
+                img = self.augmenter.process(decoded, rng)
+                img = np.clip(np.rint(img), 0.0, 255.0).astype(np.uint8)
         else:
+            img = self.augmenter.process(self._decode(rec), rng)
             img = self.mean.apply(img, self.aug)
         if self._label_map is not None and rec.inst_id in self._label_map:
             lab = self._label_map[rec.inst_id]

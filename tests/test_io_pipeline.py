@@ -540,3 +540,34 @@ def test_decode_pool_scales_with_threads():
     res = decode_bench(image=64, n_img=96, threads=(1, 2))
     ips = res["threads"]
     assert ips[2] >= 1.6 * ips[1], f"decode pool not scaling: {ips}"
+
+
+def test_process_u8_fast_path_matches_float_path():
+    """The uint8 crop+mirror fast path (device_normalize pipelines) must
+    produce byte-identical pixels and the SAME rng draw order as the
+    float path + rint, and decline (None) exactly the cases the float
+    path must handle (upscale, float input)."""
+    from cxxnet_tpu.config import parse_config_string
+    from cxxnet_tpu.io.augment import AugmentParams, ImageAugmenter
+    cfg = parse_config_string("""
+input_shape = 3,32,32
+rand_crop = 1
+rand_mirror = 1
+""")
+    p = AugmentParams()
+    for k, v in cfg:
+        p.set_param(k, v)
+    aug = ImageAugmenter(p, (3, 32, 32))
+    rng0 = np.random.RandomState(7)
+    img = rng0.randint(0, 256, size=(48, 40, 3)).astype(np.uint8)
+    out_u8 = aug.process_u8(img, np.random.RandomState(13))
+    out_f = aug.process(img, np.random.RandomState(13))
+    out_f = np.clip(np.rint(out_f), 0.0, 255.0).astype(np.uint8)
+    assert out_u8 is not None and out_u8.dtype == np.uint8
+    np.testing.assert_array_equal(out_u8, out_f)
+    # sub-crop image: fast path declines BEFORE any rng draw, so the
+    # float fallback sees the untouched stream
+    small = rng0.randint(0, 256, size=(16, 16, 3)).astype(np.uint8)
+    assert aug.process_u8(small, np.random.RandomState(5)) is None
+    assert aug.process_u8(img.astype(np.float32),
+                          np.random.RandomState(5)) is None
