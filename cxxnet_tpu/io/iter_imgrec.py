@@ -350,7 +350,11 @@ class ImageRecordIterator(DataIter):
 
     def _process_one(self, payload: bytes, item_counter: int):
         rec = ImageRecord.unpack(payload)
-        rng = np.random.RandomState(self._hash_seed(item_counter))
+        # Generator(PCG64) rather than RandomState: ~8x cheaper to build
+        # (~23 us vs ~180 us), and one is built per image — RandomState
+        # construction alone was ~13% of the host input budget
+        rng = np.random.Generator(
+            np.random.PCG64(self._hash_seed(item_counter)))
         if self.aug.device_normalize:
             # defer mean/divideby/scale to the device (trainer applies them
             # after a 4x smaller uint8 host->device copy); crop/mirror
