@@ -342,11 +342,14 @@ class ImageRecordIterator(DataIter):
     @staticmethod
     def _hash_seed(counter: int) -> int:
         """splitmix64-style integer mix so consecutive counters (and
-        shifted seed/rank bases) yield uncorrelated RNG streams."""
+        shifted seed/rank bases) yield uncorrelated RNG streams. Full
+        64-bit output — PCG64 takes it whole; the old 31-bit truncation
+        (a RandomState seed-range limit) would birthday-collide hundreds
+        of item pairs per ImageNet-scale epoch."""
         z = (counter + 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
         z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
         z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
-        return (z ^ (z >> 31)) & 0x7FFFFFFF
+        return z ^ (z >> 31)
 
     def _process_one(self, payload: bytes, item_counter: int):
         rec = ImageRecord.unpack(payload)

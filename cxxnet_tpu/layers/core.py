@@ -409,10 +409,41 @@ class FixConnectLayer(Layer):
 
 @register_layer("maxout")
 class MaxoutLayer(Layer):
-    """Placeholder: the reference declares kMaxout (layer.h:306) but ships no
-    implementation (layer_impl-inl.hpp factory has no case for it)."""
+    """Maxout (Goodfellow et al. 2013): channels split into groups of
+    ``num_piece`` and the output takes the elementwise max per group
+    (cout = cin / num_piece).
+
+    The reference DECLARES kMaxout (layer.h:344) but ships no
+    implementation (layer_impl-inl.hpp's factory has no case for it);
+    this is a real implementation going beyond that parity point. Works
+    on conv (b,h,w,c) and flat nodes (max over the trailing feature
+    axis); pairs with a preceding conv/fullc exactly like the paper's
+    affine-then-max formulation."""
+
+    def set_param(self, name, val):
+        if name == "num_piece":
+            self.num_piece = int(val)
 
     def __init__(self, spec, global_cfg):
-        raise NotImplementedError(
-            "maxout is declared but not implemented in the reference; "
-            "it is likewise unavailable here")
+        self.num_piece = 2
+        super().__init__(spec, global_cfg)
+
+    def infer_shapes(self, in_shapes):
+        self.check_n(in_shapes, 1, 1)
+        c, y, x = in_shapes[0]
+        # the trailing array axis holds channels for conv nodes (NHWC)
+        # and features for flat nodes ((b,1,1,f) — base.to_nhwc)
+        feat = x if is_flat(in_shapes[0]) else c
+        if self.num_piece < 1 or feat % self.num_piece:
+            raise ValueError(
+                f"maxout: channel/feature count {feat} not divisible by "
+                f"num_piece {self.num_piece}")
+        if is_flat(in_shapes[0]):
+            return [(1, 1, x // self.num_piece)]
+        return [(c // self.num_piece, y, x)]
+
+    def apply(self, params, state, inputs, ctx):
+        x = inputs[0]
+        k = self.num_piece
+        grouped = x.reshape(x.shape[:-1] + (x.shape[-1] // k, k))
+        return [jnp.max(grouped, axis=-1)], state

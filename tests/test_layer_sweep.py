@@ -35,6 +35,8 @@ CASES = {
     "insanity_max_pooling": (
         IMG, "layer[+1] = insanity_max_pooling\n  kernel_size = 2\n"),
     "lrn": (IMG, "layer[+1] = lrn\n  local_size = 3\n"),
+    "maxout": (IMG, "layer[+1] = conv\n  kernel_size = 3\n"
+               "  nchannel = 4\nlayer[+1] = maxout\n  num_piece = 2\n"),
     "xelu": (FLAT, "layer[+1] = xelu\n  b = 2\n"),
     "insanity": (FLAT, "layer[+1] = insanity\n"),
     "rrelu": (FLAT, "layer[+1] = rrelu\n"),
@@ -72,9 +74,9 @@ CASES = {
 }
 
 # covered separately: share/pairtest/fixconn in test_layers.py and below,
-# maxout is declared-but-unimplemented parity, plugin needs a user class file
+# plugin needs a user class file
 # (exercised by tests/test_layers.py::test_plugin_layer).
-UNTESTABLE = {"share", "pairtest", "fixconn", "maxout", "plugin"}
+UNTESTABLE = {"share", "pairtest", "fixconn", "plugin"}
 
 
 def test_sweep_covers_every_registered_type():
@@ -131,10 +133,44 @@ def test_fixconn(tmp_path):
                                np.asarray(data).reshape(4, 24) @ w, atol=1e-6)
 
 
-def test_maxout_matches_reference_absence():
-    # the reference declares kMaxout but ships no implementation; we raise
+def test_maxout_values_and_shapes():
+    """maxout (the reference declares kMaxout, layer.h:344, but ships no
+    implementation — this one is real): channels group by num_piece and
+    take the elementwise max; works on conv AND flat nodes."""
+    import jax
+    from cxxnet_tpu.layers import create_layer
+    from cxxnet_tpu.layers.base import ApplyCtx
+    rng = np.random.RandomState(3)
+    # conv node: (b, h, w, c=6), num_piece=3 -> c_out=2
     cfg = parse_config_string(
-        f"netconfig=start\nlayer[+1] = maxout\nnetconfig=end\n"
-        f"input_shape = {FLAT}\nbatch_size = 4\n")
-    with pytest.raises(NotImplementedError):
-        Network(build_graph(cfg), cfg)
+        "netconfig=start\nlayer[+1] = maxout\n  num_piece = 3\n"
+        "netconfig=end\ninput_shape = 6,4,4\nbatch_size = 2\n")
+    g = build_graph(cfg)
+    layer = create_layer(g.layers[0], g.defcfg)
+    assert layer.infer_shapes([(6, 4, 4)]) == [(2, 4, 4)]
+    x = rng.randn(2, 4, 4, 6).astype(np.float32)
+    (out,), _ = layer.apply({}, {}, [jnp.asarray(x)],
+                            ApplyCtx(train=True,
+                                     rng=jax.random.PRNGKey(0)))
+    np.testing.assert_allclose(np.asarray(out),
+                               x.reshape(2, 4, 4, 2, 3).max(-1),
+                               rtol=1e-6)
+    # flat node: features on the trailing axis
+    cfg = parse_config_string(
+        "netconfig=start\nlayer[+1] = maxout\n  num_piece = 2\n"
+        "netconfig=end\ninput_shape = 1,1,8\nbatch_size = 4\n")
+    g = build_graph(cfg)
+    layer = create_layer(g.layers[0], g.defcfg)
+    assert layer.infer_shapes([(1, 1, 8)]) == [(1, 1, 4)]
+    xf = rng.randn(4, 1, 1, 8).astype(np.float32)
+    (outf,), _ = layer.apply({}, {}, [jnp.asarray(xf)],
+                             ApplyCtx(train=True,
+                                      rng=jax.random.PRNGKey(0)))
+    np.testing.assert_allclose(np.asarray(outf),
+                               xf.reshape(4, 1, 1, 4, 2).max(-1),
+                               rtol=1e-6)
+    # indivisible count: clean error
+    layer2 = create_layer(g.layers[0], g.defcfg)
+    layer2.num_piece = 3
+    with pytest.raises(ValueError, match="num_piece"):
+        layer2.infer_shapes([(1, 1, 8)])
