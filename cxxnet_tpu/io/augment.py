@@ -18,13 +18,13 @@ case has no cv2 dependency.
 from __future__ import annotations
 
 import os
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence, Tuple, Union
 
 import numpy as np
 
 # either numpy RNG API (see _ri): the per-item decode rng is a
 # Generator(PCG64); long-lived callers still pass RandomState
-RngLike = "np.random.Generator | np.random.RandomState"
+RngLike = Union[np.random.Generator, np.random.RandomState]
 
 
 class AugmentParams:
@@ -158,7 +158,7 @@ class ImageAugmenter:
         self.p = p
         self.out_c, self.out_y, self.out_x = out_shape
 
-    def _affine(self, img: np.ndarray, rng: "RngLike") -> np.ndarray:
+    def _affine(self, img: np.ndarray, rng: RngLike) -> np.ndarray:
         import cv2
         p = self.p
         if p.rotate_list:
@@ -198,7 +198,7 @@ class ImageAugmenter:
             img, m, (self.out_x, self.out_y), flags=cv2.INTER_LINEAR,
             borderMode=cv2.BORDER_CONSTANT, borderValue=(fv, fv, fv))
 
-    def _crop(self, img: np.ndarray, rng: "RngLike") -> np.ndarray:
+    def _crop(self, img: np.ndarray, rng: RngLike) -> np.ndarray:
         """Random/center/fixed crop to (out_y, out_x)
         (iter_augment_proc-inl.hpp:60-140)."""
         h, w = img.shape[:2]
@@ -224,7 +224,7 @@ class ImageAugmenter:
         return img[y0:y0 + oy, x0:x0 + ox]
 
     def process_u8(self, img: np.ndarray,
-                   rng: "RngLike"):
+                   rng: RngLike):
         """uint8-exact fast path for the device_normalize pipeline:
         crop + mirror without the float32 round-trip (process() costs
         five full-image passes — float cast, contiguous copy, rint,
@@ -256,7 +256,7 @@ class ImageAugmenter:
         return cropped
 
     def process(self, img: np.ndarray,
-                rng: "RngLike") -> np.ndarray:
+                rng: RngLike) -> np.ndarray:
         """HWC uint8/float in, (out_y, out_x, C) float32 out (pre-mean)."""
         img = np.asarray(img, np.float32)
         if img.ndim == 2:

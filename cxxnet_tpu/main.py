@@ -17,6 +17,8 @@ import sys
 import time
 from typing import Dict, List, Optional, Tuple
 
+import numpy as np
+
 from .config import ConfigPairs, parse_cli_overrides, parse_config_file
 from .graph import global_param
 from .io.data import DataBatch, create_iterator
@@ -52,15 +54,21 @@ def split_sections(cfg: ConfigPairs):
     return global_cfg, sections
 
 
-def _text_out(path: str):
-    """Text output stream for pred/extract/get_weight results — local or
-    remote (gs:// etc) through the io.stream seam."""
+def _open_out(path: str, mode: str = "w"):
+    """Output stream for pred/extract/get_weight results — local or
+    remote (gs:// etc) through the io.stream seam. mode 'w' = text,
+    'wb' = binary (output_format = bin)."""
     import io as _io
     from .io import stream
     if stream.is_remote(path):
         raw = stream.sopen(path, "wb")
-        return _io.TextIOWrapper(raw, encoding="utf-8")
-    return open(path, "w")
+        return raw if mode == "wb" else _io.TextIOWrapper(
+            raw, encoding="utf-8")
+    return open(path, mode)
+
+
+def _text_out(path: str):
+    return _open_out(path, "w")
 
 
 class LearnTask:
@@ -71,6 +79,10 @@ class LearnTask:
         self.task = gp("task", "train")
         self.net_type = gp("net_type", "")
         self.num_round = int(gp("num_round", "10"))
+        # cap on rounds run THIS invocation (reference cxxnet_main.cpp:
+        # 458-459: resume at round 30 with max_round=5 runs 5 rounds);
+        # 0 = unlimited (the reference default is INT_MAX)
+        self.max_round = int(gp("max_round", "0"))
         self.start_counter = int(gp("start_counter", "0"))
         self.print_step = int(gp("print_step", "100"))
         self.save_period = int(gp("save_period", "1"))
@@ -223,14 +235,22 @@ class LearnTask:
                     print(f"profiler trace written to {self.profile_dir}")
         if self.save_model and not self.test_io:
             from .io import stream
-            final = ckpt.model_path(self.model_dir, self.num_round - 1)
+            # the last round actually RUN (max_round may cap below
+            # num_round)
+            final = ckpt.model_path(
+                self.model_dir,
+                getattr(self, "_end_round", self.num_round) - 1)
             if not stream.exists(final):
                 tr.save_model(final)
         tr.wait_saves()       # drain async checkpoint writes before exit
 
     def _train_rounds(self, tr, itr_train, evals) -> None:
         start = time.time()
-        for r in range(self.start_counter, self.num_round):
+        end_round = self.num_round
+        if self.max_round > 0:
+            end_round = min(end_round, self.start_counter + self.max_round)
+        self._end_round = end_round
+        for r in range(self.start_counter, end_round):
             tr.start_round(r)
             batch_count = 0
             n_images = 0
@@ -305,34 +325,71 @@ class LearnTask:
         if not self.silent:
             print(f"finished raw prediction, write into {self.name_pred}")
 
+    def _output_txt(self) -> bool:
+        """output_format = txt (default) | bin — reference
+        cxxnet_main.cpp:145-148 (bin = raw little-endian float32).
+        Anything else fails fast: a silently-accepted typo ('Bin',
+        'binary') would write text where the consumer expects floats."""
+        fmt = global_param(self.global_cfg, "output_format", "txt")
+        if fmt not in ("txt", "bin"):
+            raise ValueError(
+                f"output_format must be 'txt' or 'bin', got {fmt!r}")
+        return fmt != "bin"
+
     def task_extract(self) -> None:
         tr = self.trainer
         self._init_model()
         itr = self.pred_iter() or self.train_iter()
         if itr is None:
             raise ValueError("no pred/data section in config")
-        with _text_out(self.name_pred) as f:
+        txt = self._output_txt()
+        nrow = 0
+        with (_text_out(self.name_pred) if txt
+              else _open_out(self.name_pred, "wb")) as f:
             for batch in itr:
                 feats = tr.extract_feature(batch, self.extract_node_name)
-                for row in feats:
-                    f.write(" ".join(f"{float(v):g}" for v in row) + "\n")
+                nrow += feats.shape[0]
+                if txt:
+                    for row in feats:
+                        f.write(" ".join(f"{float(v):g}" for v in row)
+                                + "\n")
+                else:
+                    f.write(np.ascontiguousarray(feats,
+                                                 "<f4").tobytes())
+        # .meta sidecar: "nrow,c,y,x" (reference cxxnet_main.cpp:418)
+        c, y, x = tr.node_shape(self.extract_node_name)
+        with _text_out(self.name_pred + ".meta") as f:
+            f.write(f"{nrow},{c},{y},{x}\n")
         if not self.silent:
             print(f"finished feature extraction, write into {self.name_pred}")
 
     def task_get_weight(self) -> None:
         tr = self.trainer
         self._init_model()
-        layer = global_param(self.global_cfg, "weight_layer", "")
-        tag = global_param(self.global_cfg, "weight_tag", "wmat")
+        gp = lambda n, d: global_param(self.global_cfg, n, d)
+        # reference keys (cxxnet_main.cpp:143-147, TaskGetWeight
+        # :335-360); weight_layer/weight_tag are kept as aliases from
+        # earlier rounds of this framework
+        layer = gp("extract_layer_name", "") or gp("weight_layer", "")
+        tag = gp("weight_name", "") or gp("weight_tag", "wmat")
+        out_path = gp("weight_filename", "") or self.name_pred
         if not layer:
-            raise ValueError("get_weight requires weight_layer=<name>")
+            raise ValueError(
+                "get_weight requires extract_layer_name=<layer>")
         w = tr.get_weight(layer, tag)
-        with _text_out(self.name_pred) as f:
+        w2 = w.reshape(w.shape[0], -1)
+        if self._output_txt():
+            with _text_out(out_path) as f:
+                for row in w2:
+                    f.write(" ".join(f"{float(v):g}" for v in row) + "\n")
+        else:
+            with _open_out(out_path, "wb") as f:
+                f.write(np.ascontiguousarray(w2, "<f4").tobytes())
+        # .meta sidecar with the weight shape (cxxnet_main.cpp:354-358)
+        with _text_out(out_path + ".meta") as f:
             f.write(" ".join(str(d) for d in w.shape) + "\n")
-            for row in w.reshape(w.shape[0], -1):
-                f.write(" ".join(f"{float(v):g}" for v in row) + "\n")
         if not self.silent:
-            print(f"weight {layer}:{tag} -> {self.name_pred}")
+            print(f"finished getting weight, write into {out_path}")
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -1596,6 +1596,17 @@ class Trainer:
         n_real = batch.batch_size - batch.num_batch_padd
         return out.reshape(out.shape[0], -1)[:n_real]
 
+    def node_shape(self, node_name: str) -> Tuple[int, int, int]:
+        """Per-instance (c, y, x) shape of a named node ('top' = the final
+        node) — the extract task's .meta sidecar needs it (the reference
+        records pred[0].shape_, cxxnet_main.cpp:402,418)."""
+        g = self.graph
+        if node_name in ("top", "top[-1]"):
+            idx = g.layers[-1].nindex_out[0]
+        else:
+            idx = g.node_names.index(node_name)
+        return tuple(self.net.node_shapes[idx])
+
     def extract_feature(self, batch: DataBatch, node_name: str) -> np.ndarray:
         """Extract an intermediate node's value by name (reference
         ExtractFeature, nnet_impl-inl.hpp; 'top' = last node)."""

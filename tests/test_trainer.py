@@ -474,3 +474,65 @@ def test_mesh_axis_nesting_keeps_fast_axes_innermost():
     # model groups: innermost pairs; pipe groups: stride-4 within a block
     assert arr[0, 0, 0, 0].id + 1 == arr[0, 0, 0, 1].id
     assert arr[0, 1, 0, 0].id - arr[0, 0, 0, 0].id == n_inner // 2
+
+
+def test_task_get_weight_and_extract_reference_keys(tmp_path, mesh8):
+    """The reference's exact task keys work: max_round caps rounds this
+    invocation, get_weight honors extract_layer_name / weight_name /
+    weight_filename / output_format=bin with a .meta shape sidecar, and
+    extract writes its nrow,c,y,x .meta (cxxnet_main.cpp:143-147,
+    335-360, 418)."""
+    import jax
+    from cxxnet_tpu.parallel import make_mesh_context
+    conf = f"""
+data = train
+{SYN_ITER}
+iter = end
+{MLP_CFG}
+num_round = 9
+max_round = 2
+model_dir = {tmp_path}/models
+print_step = 0
+silent = 1
+dev = cpu
+"""
+    task = LearnTask(parse_config_string(conf))
+    task.trainer.mesh = make_mesh_context(devices=jax.devices())
+    task.run()
+    # max_round=2: rounds 0..1 ran, final model is 0001 (not 0008)
+    assert os.path.exists(f"{tmp_path}/models/0001.model")
+    assert not os.path.exists(f"{tmp_path}/models/0008.model")
+
+    wconf = conf + f"""
+task = get_weight
+model_in = {tmp_path}/models/0001.model
+extract_layer_name = fc1
+weight_name = wmat
+weight_filename = {tmp_path}/w.bin
+output_format = bin
+"""
+    t2 = LearnTask(parse_config_string(wconf))
+    t2.trainer.mesh = make_mesh_context(devices=jax.devices())
+    t2.run()
+    meta = open(f"{tmp_path}/w.bin.meta").read().split()
+    shape = tuple(int(v) for v in meta)
+    w = np.frombuffer(open(f"{tmp_path}/w.bin", "rb").read(),
+                      "<f4").reshape(shape)
+    np.testing.assert_allclose(
+        w, t2.trainer.get_weight("fc1", "wmat"), rtol=1e-6)
+
+    econf = conf + f"""
+task = extract
+model_in = {tmp_path}/models/0001.model
+extract_node_name = a1
+name_pred = {tmp_path}/feat.txt
+"""
+    t3 = LearnTask(parse_config_string(econf))
+    t3.trainer.mesh = make_mesh_context(devices=jax.devices())
+    t3.run()
+    nrow, c, y, x = (int(v) for v in
+                     open(f"{tmp_path}/feat.txt.meta").read()
+                     .strip().split(","))
+    assert (nrow, c, y, x) == (512, 1, 1, 32)
+    rows = open(f"{tmp_path}/feat.txt").read().strip().splitlines()
+    assert len(rows) == 512 and len(rows[0].split()) == 32
