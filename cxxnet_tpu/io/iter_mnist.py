@@ -52,6 +52,11 @@ class MNISTIterator(DataIter):
             self.round_batch = int(val)
         elif name == "silent":
             self.silent = int(val)
+        elif name == "index_offset":
+            # base added to instance indices (reference
+            # iter_mnist-inl.hpp:33 inst_offset_) — aligns ids with
+            # attachtxt side files numbered from a nonzero base
+            self.index_offset = int(val)
 
     def __init__(self, cfg):
         self.path_img = ""
@@ -62,6 +67,7 @@ class MNISTIterator(DataIter):
         self.seed = 0
         self.round_batch = 0
         self.silent = 0
+        self.index_offset = 0
         super().__init__(cfg)
 
     def init(self):
@@ -74,7 +80,7 @@ class MNISTIterator(DataIter):
             h, w = images.shape[1], images.shape[2]
             self.images = images.reshape(n, h, w, 1)
         self.labels = labels.reshape(n, 1)
-        self.inst = np.arange(n, dtype=np.int64)
+        self.inst = np.arange(n, dtype=np.int64) + self.index_offset
         self._order = np.arange(n)
         self._rng = np.random.RandomState(self.seed)
         self.before_first()

@@ -82,7 +82,9 @@ class DenseBufferIterator(DataIter):
     """Cache the first max_buffer batches in RAM, then loop over them."""
 
     def set_param(self, name, val):
-        if name == "max_buffer":
+        # max_nbatch is the reference's name (iter_mem_buffer-inl.hpp:27);
+        # max_buffer kept as this framework's earlier alias
+        if name in ("max_buffer", "max_nbatch"):
             self.max_buffer = int(val)
 
     def __init__(self, cfg, base: DataIter):
@@ -130,6 +132,8 @@ class CSVIterator(DataIter):
             self.input_shape = tuple(int(x) for x in val.split(","))
         elif name == "seed_data":
             self.seed = int(val)
+        elif name == "has_header":
+            self.has_header = int(val)
 
     def __init__(self, cfg):
         self.filename = ""
@@ -138,11 +142,13 @@ class CSVIterator(DataIter):
         self.shuffle = 0
         self.input_shape = None
         self.seed = 0
+        self.has_header = 0
         super().__init__(cfg)
 
     def init(self):
         raw = np.loadtxt(self.filename, delimiter=",", dtype=np.float32,
-                         ndmin=2)
+                         ndmin=2,
+                         skiprows=1 if self.has_header else 0)
         self.labels = raw[:, :self.label_width]
         feats = raw[:, self.label_width:]
         n = feats.shape[0]

@@ -1600,11 +1600,9 @@ class Trainer:
         """Per-instance (c, y, x) shape of a named node ('top' = the final
         node) — the extract task's .meta sidecar needs it (the reference
         records pred[0].shape_, cxxnet_main.cpp:402,418)."""
-        g = self.graph
         if node_name in ("top", "top[-1]"):
-            idx = g.layers[-1].nindex_out[0]
-        else:
-            idx = g.node_names.index(node_name)
+            return tuple(self.net.out_shape())
+        idx = self.graph.node_names.index(node_name)
         return tuple(self.net.node_shapes[idx])
 
     def extract_feature(self, batch: DataBatch, node_name: str) -> np.ndarray:
