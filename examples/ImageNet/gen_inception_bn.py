@@ -190,6 +190,13 @@ def generate(scale: float = 1.0, image_size: int = 224,
     w.line("num_round = 40")
     w.line("metric = rec@1")
     w.line("metric = rec@5")
+    if stage_split:
+        # the stage dialect implies the pipeline globals: S stages, and
+        # a 2S microbatch depth (a reasonable bubble/memory default the
+        # user can override on the CLI)
+        n_stages = len(stage_split) + 1
+        w.line(f"pipeline_parallel = {n_stages}")
+        w.line(f"pipeline_microbatch = {2 * n_stages}")
     return w.buf.getvalue()
 
 

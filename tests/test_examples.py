@@ -29,6 +29,7 @@ ALL_CONFS = [
     "MNIST/mnist_lenet.conf",
     "ImageNet/alexnet.conf",
     "ImageNet/kaiming.conf",
+    "ImageNet/inception_bn_pp.conf",
     "kaggle_bowl/bowl.conf",
 ]
 
@@ -133,3 +134,26 @@ def test_alexnet_reduced_trains(mesh8):
     small = [(k, "64" if k == "nhidden" and v == "4096" else v)
              for k, v in global_cfg]
     _tiny_step(small, (3, 227, 227), 1000, mesh8)
+
+
+def test_inception_bn_pp_conf_stage_partitions():
+    """The committed 2-stage pipeline flagship config is reproducible
+    from its generator and stage-partitions cleanly (stage dialect +
+    emitted pipeline globals; generic parse/build coverage comes from
+    ALL_CONFS, and the numeric pp==unsharded equivalence is covered at
+    reduced scale in tests/test_parallel_ext.py)."""
+    from gen_inception_bn import generate
+    from cxxnet_tpu.model import Network
+    path = os.path.join(EXAMPLES, "ImageNet", "inception_bn_pp.conf")
+    assert open(path).read() == generate(
+        scale=1.0, image_size=224, num_class=1000, batch_size=128,
+        with_data=True, stage_split=("4a",)), \
+        "inception_bn_pp.conf drifted from its generator — regenerate"
+    cfg = parse_config_file(path)
+    global_cfg, sections = split_sections(cfg)
+    assert ("pipeline_parallel", "2") in global_cfg
+    net = Network(build_graph(global_cfg), global_cfg)
+    (lo0, hi0), (lo1, hi1) = net.stage_partition(2)
+    assert lo0 == 0 and hi0 == lo1 and hi1 > lo1
+    # the cut lands at inception block 4a and both stages carry real work
+    assert hi0 > 20 and hi1 - lo1 > 20
