@@ -101,6 +101,12 @@ class LearnTask:
         # test_io=1: run the full input pipeline but skip Update — isolates
         # input throughput (reference cxxnet_main.cpp:455-469, doc/debug_perf.md)
         self.test_io = int(gp("test_io", "0"))
+        # train_chain=k: fuse k DISTINCT batches into one device dispatch
+        # (Trainer.update_chain_batches) — amortizes the remote-chip
+        # dispatch RTT for small models; no reference analog (its driver
+        # sat on the PCIe bus). Requires eval_train=0 (chains don't
+        # capture train metrics), std mode, update_period=1.
+        self.train_chain = int(gp("train_chain", "0"))
         # profile_dir=<path>: capture a profiler trace of the train loop
         # (view with xprof/tensorboard); the reference prescribed external
         # tools only (doc/debug_perf.md) — built-in here
@@ -250,30 +256,70 @@ class LearnTask:
         if self.max_round > 0:
             end_round = min(end_round, self.start_counter + self.max_round)
         self._end_round = end_round
+        chain = self.train_chain if self.train_chain > 1 else 0
+        if chain and (tr.eval_train or tr.update_period > 1
+                      or tr.mesh.seq_parallel > 1
+                      or tr.mesh.pipeline_parallel > 1):
+            raise ValueError(
+                "train_chain requires eval_train = 0, update_period = 1, "
+                "and standard (dp/tp) mode — chains do not capture train "
+                "metrics or compose with accumulation/sp/pp")
         for r in range(self.start_counter, end_round):
             tr.start_round(r)
             batch_count = 0
             n_images = 0
             round_start = time.time()
             # prefetch_device stages batch N+1's H2D + normalize while
-            # step N computes (device-side double buffering)
-            batches = (itr_train if self.test_io
+            # step N computes (device-side double buffering); train_chain
+            # instead stacks k host batches and fuses their steps into
+            # one dispatch (the H2D overlap comes from the chain itself)
+            batches = (itr_train if (self.test_io or chain)
                        else tr.prefetch_device(itr_train))
+            pending = []
+            pending_rows = 0
             for batch in batches:
                 if self.test_io:
                     n_images += batch.batch_size - batch.num_batch_padd
                     batch_count += 1
                     continue
-                tr.update(batch)
-                n_images += batch.batch_size - batch.num_batch_padd
-                batch_count += 1
-                if self.print_step and batch_count % self.print_step == 0 \
+                real_rows = batch.batch_size - batch.num_batch_padd
+                if chain:
+                    # host copies: iterators may hand out views into
+                    # buffers they refill on the next next()
+                    pending.append(DataBatch(
+                        data=np.array(batch.data),
+                        label=np.array(batch.label),
+                        num_batch_padd=batch.num_batch_padd,
+                        extra_data=[np.array(e)
+                                    for e in batch.extra_data],
+                        norm=batch.norm))
+                    pending_rows += real_rows
+                    if len(pending) < chain:
+                        continue
+                    # progress accounting covers DISPATCHED work only —
+                    # queued-but-untrained batches must not inflate
+                    # images/sec or read a stale/absent loss
+                    tr.update_chain_batches(pending)
+                    batch_count += len(pending)
+                    n_images += pending_rows
+                    pending, pending_rows = [], 0
+                else:
+                    tr.update(batch)
+                    n_images += real_rows
+                    batch_count += 1
+                if self.print_step \
+                        and batch_count // self.print_step \
+                        != (batch_count - (chain or 1)) // self.print_step \
                         and not self.silent:
                     elapsed = int(time.time() - start)
                     ips = n_images / max(time.time() - round_start, 1e-9)
                     print(f"round {r:8d}:[{batch_count:8d}] {elapsed} sec "
                           f"elapsed, loss={tr.last_loss:.6f}, "
                           f"{ips:.1f} images/sec", flush=True)
+            for b in pending:      # epoch tail shorter than the chain
+                tr.update(b)
+                n_images += b.batch_size - b.num_batch_padd
+                batch_count += 1
             if self.test_io:
                 dt = max(time.time() - round_start, 1e-9)
                 print(f"round {r:8d}: test_io {n_images} images in "

@@ -1146,15 +1146,17 @@ class Trainer:
                                 axis_names=axes)
         return jax.jit(wrapped)
 
-    def _make_train_step(self, do_update: bool, chain: int = 0):
-        """Standard (GSPMD dp/tp) train step. ``chain`` > 0: k steps on
-        one fixed batch fused into ONE dispatch via the shared
-        _chain_scan wrapper (update_chain; no metric capture). Exists
-        because per-step dispatch over a remote-device link measures the
-        link, not the chip (the reference's per-batch Update never had
-        this problem — its driver sat on the PCIe bus): bench.py times a
-        k-chain and divides. The rng chains per-step exactly as
-        ``update`` does."""
+    def _make_train_step(self, do_update: bool, chain: int = 0,
+                         multi: bool = False):
+        """Standard (GSPMD dp/tp) train step. ``chain`` > 0: k steps
+        fused into ONE dispatch via lax.scan (no metric capture) — on
+        one fixed batch (update_chain; bench timing), or with
+        ``multi=True`` over k DISTINCT stacked batches
+        (update_chain_batches; real training with the per-dispatch link
+        overhead amortized k-fold). Exists because per-step dispatch
+        over a remote-device link costs a ~5-8 ms RTT floor the
+        reference never had — its driver sat on the PCIe bus. The rng
+        chains per-step exactly as ``update`` does."""
         net, opt, period = self.net, self.optimizer, self.update_period
         needed = [] if chain else self._needed_nodes()
         capture = bool(needed)
@@ -1175,6 +1177,21 @@ class Trainer:
             return (params, opt_state, new_state, accum, loss, nodes,
                     jax.random.fold_in(rng, 1))
 
+        if chain and multi:
+            def step(params, opt_state, net_state, data, label, mask,
+                     extra, rng, sched):
+                def sbody(carry, xs):
+                    p, o, s, r = carry
+                    d, l, m, e = xs
+                    p, o, s, _a, loss, _n, r = one(
+                        p, o, s, {}, d, l, m, e, r, sched)
+                    return (p, o, s, r), loss
+                (params, opt_state, net_state, rng), losses = \
+                    jax.lax.scan(sbody,
+                                 (params, opt_state, net_state, rng),
+                                 (data, label, mask, extra))
+                return params, opt_state, net_state, losses, rng
+            return jax.jit(step, donate_argnums=(0, 1, 2))
         if chain:
             def step(params, opt_state, net_state, data, label, mask,
                      extra, rng, sched):
@@ -1224,6 +1241,72 @@ class Trainer:
             + (self._rng_key, self._sched_scalars())
         (self.params, self.opt_state, self.net_state, losses,
          self._rng_key) = self._train_step_fns[key](*args)
+        self._last_loss = losses[-1]
+        self._step_count += k
+        self.sample_counter = 0
+        self.epoch_counter += k
+        return losses
+
+    def update_chain_batches(self, batches) -> "jax.Array":
+        """Run len(batches) train steps on DISTINCT batches in one device
+        dispatch (lax.scan over the stacked batch arrays) — real
+        training with the per-dispatch link overhead amortized, for
+        small models on remote-attached chips (task driver knob
+        ``train_chain = k``). Same math as k sequential ``update()``
+        calls: per-batch padding masks apply, the rng chains per step;
+        LR/momentum schedules are evaluated once at chain entry and
+        held. Standard (dp/tp) mode; no gradient accumulation or
+        train-metric capture."""
+        assert self.params is not None, "call init_model() first"
+        k = len(batches)
+        if k == 0:
+            raise ValueError("update_chain_batches: empty batch list")
+        if self._pp > 1 or self._sp > 1:
+            raise ValueError("update_chain_batches: std mode only")
+        if self.update_period > 1:
+            raise ValueError("update_chain_batches: update_period "
+                             "accumulation does not chain")
+        from jax.sharding import PartitionSpec as P
+        da = self.mesh.data_axis
+
+        def put(arr, ndim_tail):
+            return jax.device_put(arr, self.mesh.named(
+                P(None, da, *([None] * ndim_tail))))
+        data = put(np.stack([np.asarray(b.data) for b in batches]),
+                   np.ndim(batches[0].data) - 1)
+        # one normalize over the stacked array — all batches must share
+        # the deferred-norm constants (same iterator => same metadata)
+        norms = {(None if b.norm is None else
+                  (np.asarray(b.norm.get("mean"), np.float32).tobytes()
+                   if b.norm.get("mean") is not None else None,
+                   float(b.norm.get("divideby", 1.0)),
+                   float(b.norm.get("scale", 1.0)))) for b in batches}
+        if len(norms) != 1:
+            raise ValueError("update_chain_batches: batches carry "
+                             "different deferred-norm metadata")
+        data = self._device_normalize(data, batches[0])
+        label = put(np.stack([np.asarray(b.label) for b in batches]), 1)
+        masks = np.ones((k, batches[0].batch_size), np.float32)
+        for i, b in enumerate(batches):
+            if b.num_batch_padd:
+                masks[i, b.batch_size - b.num_batch_padd:] = 0.0
+        masks = put(masks, 0)
+        n_extra = len(batches[0].extra_data)
+        extra = tuple(
+            put(np.stack([np.asarray(b.extra_data[j]) for b in batches]),
+                np.ndim(batches[0].extra_data[j]) - 1)
+            for j in range(n_extra))
+        key = ("chainb", k, n_extra)
+        if key not in self._train_step_fns:
+            self._train_step_fns[key] = self._make_train_step(
+                True, chain=k, multi=True)
+        if self._rng_key is None:
+            self._rng_key = jax.random.fold_in(self._base_key,
+                                               self._step_count)
+        (self.params, self.opt_state, self.net_state, losses,
+         self._rng_key) = self._train_step_fns[key](
+             self.params, self.opt_state, self.net_state, data, label,
+             masks, extra, self._rng_key, self._sched_scalars())
         self._last_loss = losses[-1]
         self._step_count += k
         self.sample_counter = 0
