@@ -492,16 +492,18 @@ class Trainer:
             jax.device_put(np.ascontiguousarray(label[:, a:b]), sh)
             for a, b in self.graph.label_range)
 
-    def _make_sp_train_step(self, do_update: bool, chain: int = 0):
+    def _make_sp_train_step(self, do_update: bool, chain: int = 0,
+                            multi: bool = False):
         """Sequence-parallel train step: the whole step body runs under
         shard_map over the ('data','seq') mesh; mha layers take the ring
         path, gradients of replicated params are psum'd automatically by
         shard_map's transpose, and the loss is averaged across shards;
         the shard indices fold into the dropout rng so masks are
         independent per shard. ``chain`` > 0: lax.scan ``chain`` steps
-        over one fixed batch INSIDE the shard_map (update_chain — one
-        dispatch, no metric capture), returning the per-step loss
-        vector."""
+        INSIDE the shard_map — over one fixed batch (update_chain;
+        bench timing) or, with ``multi=True``, over ``chain`` DISTINCT
+        stacked batches (update_chain_batches — fused-dispatch LM
+        training); no metric capture, per-step loss vector returned."""
         from jax.sharding import PartitionSpec as P
         net, opt, period = self.net, self.optimizer, self.update_period
         seq_axis, data_axis = self.mesh.seq_axis, self.mesh.data_axis
@@ -542,7 +544,24 @@ class Trainer:
             return (params, opt_state, new_state, accum, loss, nodes,
                     jax.random.fold_in(rng, 1))
 
-        step = _chain_scan(one, chain) if chain else one
+        if chain and multi:
+            def step(params, opt_state, net_state, data, label, mask,
+                     rng, sched):
+                def sbody(carry, xs):
+                    p, o, s, r = carry
+                    d, l, m = xs
+                    p, o, s, _a, loss, _n, r = one(
+                        p, o, s, {}, d, l, m, r, sched)
+                    return (p, o, s, r), loss
+                (params, opt_state, net_state, rng), losses = \
+                    jax.lax.scan(sbody,
+                                 (params, opt_state, net_state, rng),
+                                 (data, label, mask))
+                return params, opt_state, net_state, losses, rng
+        elif chain:
+            step = _chain_scan(one, chain)
+        else:
+            step = one
         node_spec = P(data_axis, seq_axis, None, None)
         nodes_spec = {k: node_spec for k in [_TOP] + needed}
         # PARTIAL-MANUAL shard_map: only ('data','seq') go manual; the
@@ -551,7 +570,19 @@ class Trainer:
         # sequence-parallel step — this is what makes sp x tp compose
         data_spec = P(data_axis, None, None, seq_axis)
         lspec = tuple(P(data_axis, seq_axis) for _ in ranges)
-        if chain:
+        if chain and multi:
+            # stacked batches: every batch leaf gains a leading
+            # (unsharded) chain axis
+            wrapped = jax.shard_map(
+                step, mesh=self.mesh.mesh,
+                in_specs=(rep, rep, rep,
+                          P(None, data_axis, None, None, seq_axis),
+                          tuple(P(None, data_axis, seq_axis)
+                                for _ in ranges),
+                          P(None, data_axis), rep, rep),
+                out_specs=(rep, rep, rep, rep, rep),
+                axis_names={data_axis, seq_axis})
+        elif chain:
             wrapped = jax.shard_map(
                 step, mesh=self.mesh.mesh,
                 in_specs=(rep, rep, rep, data_spec, lspec,
@@ -1255,58 +1286,84 @@ class Trainer:
         ``train_chain = k``). Same math as k sequential ``update()``
         calls: per-batch padding masks apply, the rng chains per step;
         LR/momentum schedules are evaluated once at chain entry and
-        held. Standard (dp/tp) mode; no gradient accumulation or
-        train-metric capture."""
+        held. std (dp/tp) and sp modes; no gradient accumulation or
+        train-metric capture (pp models are dispatch-floor-irrelevant —
+        their steps are tens of ms)."""
         assert self.params is not None, "call init_model() first"
         k = len(batches)
         if k == 0:
             raise ValueError("update_chain_batches: empty batch list")
-        if self._pp > 1 or self._sp > 1:
-            raise ValueError("update_chain_batches: std mode only")
+        if self._pp > 1:
+            raise ValueError("update_chain_batches: std/sp modes only")
         if self.update_period > 1:
             raise ValueError("update_chain_batches: update_period "
                              "accumulation does not chain")
         from jax.sharding import PartitionSpec as P
-        da = self.mesh.data_axis
+        da, sa = self.mesh.data_axis, self.mesh.seq_axis
 
-        def put(arr, ndim_tail):
-            return jax.device_put(arr, self.mesh.named(
-                P(None, da, *([None] * ndim_tail))))
-        data = put(np.stack([np.asarray(b.data) for b in batches]),
-                   np.ndim(batches[0].data) - 1)
-        # one normalize over the stacked array — all batches must share
-        # the deferred-norm constants (same iterator => same metadata)
-        norms = {(None if b.norm is None else
-                  (np.asarray(b.norm.get("mean"), np.float32).tobytes()
-                   if b.norm.get("mean") is not None else None,
-                   float(b.norm.get("divideby", 1.0)),
-                   float(b.norm.get("scale", 1.0)))) for b in batches}
-        if len(norms) != 1:
-            raise ValueError("update_chain_batches: batches carry "
-                             "different deferred-norm metadata")
-        data = self._device_normalize(data, batches[0])
-        label = put(np.stack([np.asarray(b.label) for b in batches]), 1)
+        def put(arr, spec):
+            return jax.device_put(arr, self.mesh.named(spec))
+
+        def put_rows(arr, ndim_tail):
+            return put(arr, P(None, da, *([None] * ndim_tail)))
         masks = np.ones((k, batches[0].batch_size), np.float32)
         for i, b in enumerate(batches):
             if b.num_batch_padd:
                 masks[i, b.batch_size - b.num_batch_padd:] = 0.0
-        masks = put(masks, 0)
-        n_extra = len(batches[0].extra_data)
-        extra = tuple(
-            put(np.stack([np.asarray(b.extra_data[j]) for b in batches]),
-                np.ndim(batches[0].extra_data[j]) - 1)
-            for j in range(n_extra))
-        key = ("chainb", k, n_extra)
+        masks = put_rows(masks, 0)
+        if self._sp > 1:
+            # stacked sp staging (_shard_seq_batch per batch, + chain
+            # axis): token dim sharded over 'seq', labels pre-sliced per
+            # label_vec range with each slice (k, B, Wr) (data, seq)
+            data = put(np.stack([np.asarray(b.data) for b in batches]),
+                       P(None, da, None, None, sa))
+            labs = [np.asarray(b.label) for b in batches]
+            label = tuple(
+                put(np.stack([np.ascontiguousarray(l[:, a:b_])
+                              for l in labs]), P(None, da, sa))
+                for a, b_ in self.graph.label_range)
+            args_extra = ()
+            key = ("chainb", k, "sp")
+            maker = lambda: self._make_sp_train_step(True, chain=k,
+                                                     multi=True)
+        else:
+            data = put_rows(
+                np.stack([np.asarray(b.data) for b in batches]),
+                np.ndim(batches[0].data) - 1)
+            # one normalize over the stacked array — all batches must
+            # share the deferred-norm constants (same iterator => same
+            # metadata)
+            norms = {(None if b.norm is None else
+                      (np.asarray(b.norm.get("mean"),
+                                  np.float32).tobytes()
+                       if b.norm.get("mean") is not None else None,
+                       float(b.norm.get("divideby", 1.0)),
+                       float(b.norm.get("scale", 1.0))))
+                     for b in batches}
+            if len(norms) != 1:
+                raise ValueError("update_chain_batches: batches carry "
+                                 "different deferred-norm metadata")
+            data = self._device_normalize(data, batches[0])
+            label = put_rows(
+                np.stack([np.asarray(b.label) for b in batches]), 1)
+            n_extra = len(batches[0].extra_data)
+            args_extra = (tuple(
+                put_rows(np.stack([np.asarray(b.extra_data[j])
+                                   for b in batches]),
+                         np.ndim(batches[0].extra_data[j]) - 1)
+                for j in range(n_extra)),)
+            key = ("chainb", k, n_extra)
+            maker = lambda: self._make_train_step(True, chain=k,
+                                                  multi=True)
         if key not in self._train_step_fns:
-            self._train_step_fns[key] = self._make_train_step(
-                True, chain=k, multi=True)
+            self._train_step_fns[key] = maker()
         if self._rng_key is None:
             self._rng_key = jax.random.fold_in(self._base_key,
                                                self._step_count)
         (self.params, self.opt_state, self.net_state, losses,
          self._rng_key) = self._train_step_fns[key](
              self.params, self.opt_state, self.net_state, data, label,
-             masks, extra, self._rng_key, self._sched_scalars())
+             masks, *args_extra, self._rng_key, self._sched_scalars())
         self._last_loss = losses[-1]
         self._step_count += k
         self.sample_counter = 0
