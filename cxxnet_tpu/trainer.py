@@ -147,6 +147,7 @@ class Trainer:
         self._eval_step_fn = None
         self._last_loss = None
         self._sched_cache = None
+        self._sched_stack_cache = None
         self._mask_cache = None
         self._sp_label_cache = None
         self._rng_key = None
@@ -503,12 +504,15 @@ class Trainer:
         INSIDE the shard_map — over one fixed batch (update_chain;
         bench timing) or, with ``multi=True``, over ``chain`` DISTINCT
         stacked batches (update_chain_batches — fused-dispatch LM
-        training); no metric capture, per-step loss vector returned."""
+        training, per-step schedules + eval_train metric nodes banked
+        through the scan ys); per-step loss vector returned."""
         from jax.sharding import PartitionSpec as P
         net, opt, period = self.net, self.optimizer, self.update_period
         seq_axis, data_axis = self.mesh.seq_axis, self.mesh.data_axis
         rep = P()
-        needed = [] if chain else self._needed_nodes()
+        # multi chains bank per-step metric nodes (see _make_train_step)
+        bank = bool(multi and self.eval_train)
+        needed = self._needed_nodes() if (bank or not chain) else []
         capture = bool(needed)
 
         ranges = list(self.graph.label_range)
@@ -545,19 +549,22 @@ class Trainer:
                     jax.random.fold_in(rng, 1))
 
         if chain and multi:
+            # sched stacked (k,) per tag rides the scan xs (per-step
+            # schedules); per-step nodes bank through the ys when
+            # eval_train is on
             def step(params, opt_state, net_state, data, label, mask,
                      rng, sched):
                 def sbody(carry, xs):
                     p, o, s, r = carry
-                    d, l, m = xs
-                    p, o, s, _a, loss, _n, r = one(
-                        p, o, s, {}, d, l, m, r, sched)
-                    return (p, o, s, r), loss
-                (params, opt_state, net_state, rng), losses = \
+                    d, l, m, sc = xs
+                    p, o, s, _a, loss, nodes, r = one(
+                        p, o, s, {}, d, l, m, r, sc)
+                    return (p, o, s, r), (loss, nodes if bank else {})
+                (params, opt_state, net_state, rng), (losses, nodes) = \
                     jax.lax.scan(sbody,
                                  (params, opt_state, net_state, rng),
-                                 (data, label, mask))
-                return params, opt_state, net_state, losses, rng
+                                 (data, label, mask, sched))
+                return params, opt_state, net_state, losses, nodes, rng
         elif chain:
             step = _chain_scan(one, chain)
         else:
@@ -572,7 +579,11 @@ class Trainer:
         lspec = tuple(P(data_axis, seq_axis) for _ in ranges)
         if chain and multi:
             # stacked batches: every batch leaf gains a leading
-            # (unsharded) chain axis
+            # (unsharded) chain axis — including the banked per-step
+            # metric nodes on the way out
+            chain_nodes_spec = ({k: P(None, data_axis, seq_axis,
+                                      None, None)
+                                 for k in [_TOP] + needed} if bank else {})
             wrapped = jax.shard_map(
                 step, mesh=self.mesh.mesh,
                 in_specs=(rep, rep, rep,
@@ -580,7 +591,7 @@ class Trainer:
                           tuple(P(None, data_axis, seq_axis)
                                 for _ in ranges),
                           P(None, data_axis), rep, rep),
-                out_specs=(rep, rep, rep, rep, rep),
+                out_specs=(rep, rep, rep, rep, chain_nodes_spec, rep),
                 axis_names={data_axis, seq_axis})
         elif chain:
             wrapped = jax.shard_map(
@@ -1180,16 +1191,21 @@ class Trainer:
     def _make_train_step(self, do_update: bool, chain: int = 0,
                          multi: bool = False):
         """Standard (GSPMD dp/tp) train step. ``chain`` > 0: k steps
-        fused into ONE dispatch via lax.scan (no metric capture) — on
-        one fixed batch (update_chain; bench timing), or with
+        fused into ONE dispatch via lax.scan — on one fixed batch
+        (update_chain; bench timing, no metric capture), or with
         ``multi=True`` over k DISTINCT stacked batches
         (update_chain_batches; real training with the per-dispatch link
-        overhead amortized k-fold). Exists because per-step dispatch
+        overhead amortized k-fold, per-step schedules + eval_train
+        metric nodes riding the scan). Exists because per-step dispatch
         over a remote-device link costs a ~5-8 ms RTT floor the
         reference never had — its driver sat on the PCIe bus. The rng
         chains per-step exactly as ``update`` does."""
         net, opt, period = self.net, self.optimizer, self.update_period
-        needed = [] if chain else self._needed_nodes()
+        # multi chains (real training) bank per-step metric nodes through
+        # the scan ys so eval_train composes with train_chain; fixed-batch
+        # chains (bench timing) still discard them
+        bank = bool(multi and self.eval_train)
+        needed = self._needed_nodes() if (bank or not chain) else []
         capture = bool(needed)
 
         def one(params, opt_state, net_state, accum, data, label, mask,
@@ -1209,19 +1225,22 @@ class Trainer:
                     jax.random.fold_in(rng, 1))
 
         if chain and multi:
+            # sched arrives stacked (k,) per tag — per-step LR/momentum
+            # ride the scan xs, so chained training follows the same
+            # schedule trajectory as k plain update() calls
             def step(params, opt_state, net_state, data, label, mask,
                      extra, rng, sched):
                 def sbody(carry, xs):
                     p, o, s, r = carry
-                    d, l, m, e = xs
-                    p, o, s, _a, loss, _n, r = one(
-                        p, o, s, {}, d, l, m, e, r, sched)
-                    return (p, o, s, r), loss
-                (params, opt_state, net_state, rng), losses = \
+                    d, l, m, e, sc = xs
+                    p, o, s, _a, loss, nodes, r = one(
+                        p, o, s, {}, d, l, m, e, r, sc)
+                    return (p, o, s, r), (loss, nodes if bank else {})
+                (params, opt_state, net_state, rng), (losses, nodes) = \
                     jax.lax.scan(sbody,
                                  (params, opt_state, net_state, rng),
-                                 (data, label, mask, extra))
-                return params, opt_state, net_state, losses, rng
+                                 (data, label, mask, extra, sched))
+                return params, opt_state, net_state, losses, nodes, rng
             return jax.jit(step, donate_argnums=(0, 1, 2))
         if chain:
             def step(params, opt_state, net_state, data, label, mask,
@@ -1284,11 +1303,12 @@ class Trainer:
         training with the per-dispatch link overhead amortized, for
         small models on remote-attached chips (task driver knob
         ``train_chain = k``). Same math as k sequential ``update()``
-        calls: per-batch padding masks apply, the rng chains per step;
-        LR/momentum schedules are evaluated once at chain entry and
-        held. std (dp/tp) and sp modes; no gradient accumulation or
-        train-metric capture (pp models are dispatch-floor-irrelevant —
-        their steps are tens of ms)."""
+        calls: per-batch padding masks apply, the rng chains per step,
+        per-step LR/momentum schedule values ride the scan, and with
+        ``eval_train`` the per-step metric nodes bank through the scan
+        ys (fetched lazily, like update()'s deferred metric). std
+        (dp/tp) and sp modes; no gradient accumulation (pp models are
+        dispatch-floor-irrelevant — their steps are tens of ms)."""
         assert self.params is not None, "call init_model() first"
         k = len(batches)
         if k == 0:
@@ -1306,33 +1326,10 @@ class Trainer:
 
         def put_rows(arr, ndim_tail):
             return put(arr, P(None, da, *([None] * ndim_tail)))
-        masks = np.ones((k, batches[0].batch_size), np.float32)
-        for i, b in enumerate(batches):
-            if b.num_batch_padd:
-                masks[i, b.batch_size - b.num_batch_padd:] = 0.0
-        masks = put_rows(masks, 0)
-        if self._sp > 1:
-            # stacked sp staging (_shard_seq_batch per batch, + chain
-            # axis): token dim sharded over 'seq', labels pre-sliced per
-            # label_vec range with each slice (k, B, Wr) (data, seq)
-            data = put(np.stack([np.asarray(b.data) for b in batches]),
-                       P(None, da, None, None, sa))
-            labs = [np.asarray(b.label) for b in batches]
-            label = tuple(
-                put(np.stack([np.ascontiguousarray(l[:, a:b_])
-                              for l in labs]), P(None, da, sa))
-                for a, b_ in self.graph.label_range)
-            args_extra = ()
-            key = ("chainb", k, "sp")
-            maker = lambda: self._make_sp_train_step(True, chain=k,
-                                                     multi=True)
-        else:
-            data = put_rows(
-                np.stack([np.asarray(b.data) for b in batches]),
-                np.ndim(batches[0].data) - 1)
-            # one normalize over the stacked array — all batches must
-            # share the deferred-norm constants (same iterator => same
-            # metadata)
+
+        # one normalize over the stacked array — all batches must share
+        # the deferred-norm constants (same iterator => same metadata)
+        def check_norms():
             norms = {(None if b.norm is None else
                       (np.asarray(b.norm.get("mean"),
                                   np.float32).tobytes()
@@ -1343,6 +1340,33 @@ class Trainer:
             if len(norms) != 1:
                 raise ValueError("update_chain_batches: batches carry "
                                  "different deferred-norm metadata")
+        masks = np.ones((k, batches[0].batch_size), np.float32)
+        for i, b in enumerate(batches):
+            if b.num_batch_padd:
+                masks[i, b.batch_size - b.num_batch_padd:] = 0.0
+        masks = put_rows(masks, 0)
+        if self._sp > 1:
+            # stacked sp staging (_shard_seq_batch per batch, + chain
+            # axis): token dim sharded over 'seq', labels pre-sliced per
+            # label_vec range with each slice (k, B, Wr) (data, seq)
+            check_norms()
+            data = put(np.stack([np.asarray(b.data) for b in batches]),
+                       P(None, da, None, None, sa))
+            data = self._device_normalize(data, batches[0])
+            labs = [np.asarray(b.label) for b in batches]
+            label = tuple(
+                put(np.stack([np.ascontiguousarray(l[:, a:b_])
+                              for l in labs]), P(None, da, sa))
+                for a, b_ in self.graph.label_range)
+            args_extra = ()
+            key = ("chainb", k, "sp", bool(self.eval_train))
+            maker = lambda: self._make_sp_train_step(True, chain=k,
+                                                     multi=True)
+        else:
+            data = put_rows(
+                np.stack([np.asarray(b.data) for b in batches]),
+                np.ndim(batches[0].data) - 1)
+            check_norms()
             data = self._device_normalize(data, batches[0])
             label = put_rows(
                 np.stack([np.asarray(b.label) for b in batches]), 1)
@@ -1352,7 +1376,7 @@ class Trainer:
                                    for b in batches]),
                          np.ndim(batches[0].extra_data[j]) - 1)
                 for j in range(n_extra)),)
-            key = ("chainb", k, n_extra)
+            key = ("chainb", k, n_extra, bool(self.eval_train))
             maker = lambda: self._make_train_step(True, chain=k,
                                                   multi=True)
         if key not in self._train_step_fns:
@@ -1360,14 +1384,17 @@ class Trainer:
         if self._rng_key is None:
             self._rng_key = jax.random.fold_in(self._base_key,
                                                self._step_count)
-        (self.params, self.opt_state, self.net_state, losses,
+        (self.params, self.opt_state, self.net_state, losses, nodes,
          self._rng_key) = self._train_step_fns[key](
              self.params, self.opt_state, self.net_state, data, label,
-             masks, *args_extra, self._rng_key, self._sched_scalars())
+             masks, *args_extra, self._rng_key, self._sched_stack(k))
         self._last_loss = losses[-1]
         self._step_count += k
         self.sample_counter = 0
         self.epoch_counter += k
+        if self.eval_train and nodes:
+            self._drain_pending_metric()
+            self._pending_metric = (nodes, list(batches))
         return losses
 
     def _sched_scalars(self):
@@ -1382,6 +1409,26 @@ class Trainer:
                 tag: (jnp.float32(lr), jnp.float32(mom))
                 for tag, (lr, mom) in sched.items()})
         return self._sched_cache[1]
+
+    def _sched_stack(self, k: int):
+        """Per-step schedule values for a k-step chain, stacked (k,) per
+        tag — step i of the chain sees schedules(epoch_counter + i),
+        exactly what k sequential update() calls would. Cached by value
+        (constant schedules re-use one device upload)."""
+        scheds = [self.optimizer.schedules(self.epoch_counter + i)
+                  for i in range(k)]
+        key = tuple(sorted(
+            (tag,) + tuple(v for s in scheds for v in s[tag])
+            for tag in scheds[0]))
+        if self._sched_stack_cache is None \
+                or self._sched_stack_cache[0] != key:
+            self._sched_stack_cache = (key, {
+                tag: (jnp.asarray([s[tag][0] for s in scheds],
+                                  jnp.float32),
+                      jnp.asarray([s[tag][1] for s in scheds],
+                                  jnp.float32))
+                for tag in scheds[0]})
+        return self._sched_stack_cache[1]
 
     def _get_train_step(self, do_update: bool, batch: DataBatch):
         """Resolve (and cache) the jitted train step for the active
@@ -1704,7 +1751,14 @@ class Trainer:
         if self._pending_metric is not None:
             nodes, batch = self._pending_metric
             self._pending_metric = None
-            self._add_metric(self.train_metric, nodes, batch)
+            if isinstance(batch, list):
+                # chain-banked nodes: (k, rows, ...) stacked per step
+                for i, b in enumerate(batch):
+                    self._add_metric(self.train_metric,
+                                     {key: v[i]
+                                      for key, v in nodes.items()}, b)
+            else:
+                self._add_metric(self.train_metric, nodes, batch)
 
     def train_metric_report(self, name: str = "train") -> str:
         self._drain_pending_metric()

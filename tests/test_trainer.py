@@ -557,10 +557,48 @@ def test_update_chain_batches_matches_sequential(mesh8):
                                rtol=1e-5, atol=1e-6)
 
 
-def test_train_chain_driver_matches_plain(tmp_path, mesh8):
+def test_update_chain_batches_train_metrics_match(mesh8):
+    """eval_train=1 composes with chains: per-step metric nodes bank
+    through the scan ys and must reproduce plain update()'s
+    train-metric line, padded tails included (the reference's per-round
+    train error, cxxnet_main.cpp:487-499)."""
+    tr_c = make_trainer(mesh8)               # eval_train defaults to 1
+    tr_s = make_trainer(mesh8)
+    batches = list(synth_iter())[:4]
+    batches[-1].num_batch_padd = 8
+    tr_c.update_chain_batches(batches)
+    for b in batches:
+        tr_s.update(b)
+    line_c = tr_c.train_metric_report("train")
+    line_s = tr_s.train_metric_report("train")
+    assert "train-error" in line_c
+    assert line_c == line_s
+
+
+def test_update_chain_batches_follows_lr_schedule(mesh8):
+    """Per-step LR/momentum values ride the chain scan: with a
+    per-update factor schedule the chained weights must match k
+    sequential update() calls (not k steps at the chain-entry LR)."""
+    sched = "lr:schedule = factor\nlr:step = 1\nlr:factor = 0.5\n" \
+            "eval_train = 0\n"
+    tr_c = make_trainer(mesh8, extra=sched)
+    tr_s = make_trainer(mesh8, extra=sched)
+    batches = list(synth_iter())[:3]
+    tr_c.update_chain_batches(batches)
+    for b in batches:
+        tr_s.update(b)
+    np.testing.assert_allclose(tr_c.get_weight("fc1", "wmat"),
+                               tr_s.get_weight("fc1", "wmat"),
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_train_chain_driver_matches_plain(tmp_path, mesh8, capsys):
     """task=train with train_chain=2 (fused-dispatch training) must end
     at the same weights as the plain per-batch driver loop, including
-    the odd epoch tail batch that falls out of the chain."""
+    the odd epoch tail batch that falls out of the chain — and with
+    eval_train=1 the per-round train-metric line must match too (chains
+    bank per-step metric nodes)."""
+    import re
     import jax
     from cxxnet_tpu.parallel import make_mesh_context
     # 3 batches/epoch -> chain of 2 + a tail update per round
@@ -570,18 +608,21 @@ data = train
 {it_cfg}
 iter = end
 {MLP_CFG}
-eval_train = 0
+eval_train = 1
 num_round = 2
 print_step = 0
 silent = 1
 dev = cpu
 """
-    outs = {}
+    outs, lines = {}, {}
     for tag, extra in (("plain", ""), ("chain", "train_chain = 2\n")):
         conf = base + extra + f"model_dir = {tmp_path}/m_{tag}\n"
         task = LearnTask(parse_config_string(conf))
         task.trainer.mesh = make_mesh_context(devices=jax.devices())
         task.run()
         outs[tag] = task.trainer.get_weight("fc1", "wmat")
+        lines[tag] = re.findall(r"train-error:[0-9.]+",
+                                capsys.readouterr().out)
     np.testing.assert_allclose(outs["chain"], outs["plain"],
                                rtol=1e-5, atol=1e-6)
+    assert lines["chain"] and lines["chain"] == lines["plain"]
