@@ -155,6 +155,23 @@ class Layer:
     has_params = False
     has_state = False
     is_loss = False
+    # manual tensor parallelism under pipeline stages (Network.
+    # tp_manual_plan): tp_follow = True marks a CHANNEL-WISE layer (no
+    # cross-channel mixing on the trailing axis) that can consume a
+    # channel-sharded activation and emit one — the producing conv/fullc's
+    # output all-gather is deferred past it, cutting HBM traffic on the
+    # gathered activation. tp_channel_params/state name (C,)-shaped leaves
+    # to slice per model shard alongside the activation (BN gamma/beta,
+    # prelu slope, running stats).
+    tp_follow = False
+    tp_channel_params: Tuple[str, ...] = ()
+    tp_channel_state: Tuple[str, ...] = ()
+
+    def tp_followable(self, train: bool) -> bool:
+        """Whether this layer instance can run channel-sharded in the
+        given mode — stochastic layers veto at train time (a same-keyed
+        rng draw per shard would decorrelate from the unsharded run)."""
+        return self.tp_follow
 
     def __init__(self, spec: LayerSpec, global_cfg: ConfigPairs):
         self.spec = spec

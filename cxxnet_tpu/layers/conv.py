@@ -163,6 +163,7 @@ class _PoolingLayer(Layer):
     reducer = "max"          # max | sum
     scale_avg = False
     pre_relu = False         # relu_max_pooling fusion (layer_impl-inl.hpp:58)
+    tp_follow = True         # window over H,W only: channel-independent
 
     def infer_shapes(self, in_shapes):
         self.check_n(in_shapes, 1, 1)
@@ -235,6 +236,9 @@ class InsanityPoolingLayer(_PoolingLayer):
     reducer = "max"
     pre_relu = True
     has_state = False
+
+    def tp_followable(self, train):
+        return not train     # train-time cell-pick rng (see Layer docstring)
 
     def apply(self, params, state, inputs, ctx):
         if not ctx.train:

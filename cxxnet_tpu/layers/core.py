@@ -75,6 +75,7 @@ class FullConnectLayer(Layer):
 class _ActivationLayer(Layer):
     """Elementwise activation (activation_layer-inl.hpp:12-44)."""
     fn = staticmethod(lambda x: x)
+    tp_follow = True     # elementwise: channel-sharded inputs pass through
 
     def infer_shapes(self, in_shapes):
         self.check_n(in_shapes, 1, 1)
@@ -125,6 +126,10 @@ class DropoutLayer(Layer):
     """Inverted dropout; ``threshold`` = drop probability
     (dropout_layer-inl.hpp:12-66). Self-loop layer in the reference; here it
     simply maps input to output (identity at eval)."""
+    tp_follow = True
+
+    def tp_followable(self, train):
+        return not train     # train-time mask rng: see base docstring
 
     def set_param(self, name, val):
         if name == "threshold":
@@ -207,6 +212,8 @@ class ChConcatLayer(_ConcatBase):
 class BiasLayer(Layer):
     """Additive per-feature bias for flat nodes (bias_layer-inl.hpp:14-86)."""
     has_params = True
+    tp_follow = True
+    tp_channel_params = ("bias",)
 
     def infer_shapes(self, in_shapes):
         self.check_n(in_shapes, 1, 1)
@@ -230,6 +237,7 @@ def _xelu(x: jax.Array, b) -> jax.Array:
 @register_layer("xelu")
 class XeluLayer(Layer):
     """Leaky relu with divisor slope b, default 5 (xelu_layer-inl.hpp:15-55)."""
+    tp_follow = True
 
     def set_param(self, name, val):
         if name == "b":
@@ -330,6 +338,13 @@ class PReluLayer(Layer):
     """
     has_params = True
     param_tags = {"bias": "bias"}   # slope stored under key "bias"
+    tp_follow = True
+    tp_channel_params = ("bias",)
+
+    def tp_followable(self, train):
+        # train-time slope noise draws rng over the local channel shard —
+        # same-keyed draws per shard would decorrelate from unsharded
+        return not (train and self.random_noise > 0)
 
     def set_param(self, name, val):
         if name == "init_slope":

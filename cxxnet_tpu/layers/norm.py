@@ -34,6 +34,13 @@ from .base import Layer, is_flat, register_layer
 class _BatchNormBase(Layer):
     moving_avg = True
     has_params = True
+    # manual-tp follow: BN statistics are per-channel, so a channel-sharded
+    # activation keeps flowing — gamma/beta and the running stats slice to
+    # the local channels, and the stat-sink moments are all-gathered back
+    # to full width after apply (Network.apply_stage)
+    tp_follow = True
+    tp_channel_params = ("wmat", "bias")
+    tp_channel_state = ("running_exp", "running_var")
     # pipeline-parallel: BN is admissible in a pipeline body — train-time
     # normalization uses microbatch-local statistics (the same semantics as
     # the reference's per-GPU BN, batch_norm_layer-inl.hpp), while the raw

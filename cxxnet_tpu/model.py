@@ -311,51 +311,113 @@ class Network:
                     f"{i + 1} reads nothing from earlier stages")
         return ranges
 
-    def tp_manual_plan(self, tp_size: int) -> Dict[str, Dict[str, int]]:
-        """Static plan for MANUAL tensor parallelism inside pipeline stages:
-        {layer_name: {param_key: sharded_dim}} for every layer whose
-        param_pspecs put 'model' on a dim that divides evenly by
-        ``tp_size``. The pp step cannot leave the model axis to GSPMD —
-        automatic partitioning inserts model-axis collectives *inside*
-        lax.switch branches with module-wide rendezvous, which deadlocks
-        (devices in different stages never reach each other's ops). The
-        manual scheme slices each planned weight along its 'model' dim,
-        computes with the local shard, and all-gathers the layer output on
-        ``layer.tp_manual_axis`` — every collective stays scoped to the
-        model peers of one stage, which all execute the same branch."""
-        plan: Dict[str, Dict[str, int]] = {}
+    def tp_manual_plan(self, tp_size: int, stage_ranges=None,
+                       train: bool = True) -> Dict[int, Dict[str, Any]]:
+        """Static plan for MANUAL tensor parallelism inside pipeline stages.
+        The pp step cannot leave the model axis to GSPMD — automatic
+        partitioning inserts model-axis collectives *inside* lax.switch
+        branches with module-wide rendezvous, which deadlocks (devices in
+        different stages never reach each other's ops). The manual scheme
+        slices each planned weight along its 'model' dim (zero-padded to a
+        tp multiple when the dim does not divide) and computes with the
+        local shard; the output all-gather is DEFERRED through chains of
+        channel-wise followers (``Layer.tp_follow`` — BN, activations,
+        pooling, bias/prelu, whose per-channel params/state slice along)
+        and lands only where a channel-mixing consumer needs the full
+        activation, or at the stage boundary. Every collective stays
+        scoped to the model peers of one stage, which all execute the
+        same branch — the generalization of the reference's fullc_gather
+        hybrid (async_updater-inl.hpp:68-94).
+
+        Returns {layer_index: entry} with optional entry keys:
+          ``params``  {key: (dim, orig)} — pad dim to a tp multiple of
+                      orig, then slice this shard's span;
+          ``state``   {key: orig} — dim-0 channel slices (BN running
+                      stats at eval);
+          ``gather``  {input_pos: orig} — all-gather(+trim) these inputs
+                      before apply (first channel-mixing consumer);
+          ``out_sharded`` orig — outputs stay channel-sharded;
+          ``sink_gather`` orig — all-gather this layer's stat-sink
+                      moments back to full width after apply.
+        ``stage_ranges`` must be the pipeline's body partition — sharded
+        values never cross a stage boundary (apply_stage gathers wanted
+        nodes at stage end), so the walk resets per stage."""
+        plan: Dict[int, Dict[str, Any]] = {}
         if tp_size <= 1:
             return plan
+        g = self.graph
+        ranges = stage_ranges or [(0, len(g.layers))]
         excluded: List[Tuple[str, str]] = []
-        for li, (spec, layer) in enumerate(zip(self.graph.layers,
-                                               self.layers)):
-            if spec.is_shared or not layer.has_params:
-                continue
+        followed: List[str] = []
+
+        def slice_dims(li, layer):
+            """{key: (dim, orig)} for a producer slice, or a reason str."""
             if getattr(layer, "tp_manual_axis", None) is None:
-                excluded.append((layer.name, "no tp_manual_axis"))
-                continue
+                return "no tp_manual_axis"
             pspecs = layer.param_pspecs()
             if not pspecs:
-                excluded.append((layer.name, "no 'model' pspec "
-                                 "(e.g. grouped conv)"))
-                continue
-            dims = {key: d for key, ps in pspecs.items()
-                    for d, ax in enumerate(ps) if ax == "model"}
-            # divisibility check against the layer's actual param shapes
+                return "no 'model' pspec (e.g. grouped conv)"
             shapes = jax.eval_shape(
                 lambda _li=li: self.layers[_li].init_params(
                     jax.random.PRNGKey(0), self._in_shapes_of[_li]))
-            if dims and all(key in shapes
-                            and shapes[key].shape[d] % tp_size == 0
-                            for key, d in dims.items()):
-                plan[layer.name] = dims
-            else:
-                excluded.append((layer.name,
-                                 f"'model' dim not divisible by {tp_size}"))
+            # pspecs may name optional params the layer did not create
+            # (no_bias conv declares a "bias" pspec) — plan what exists
+            dims = {key: d for key, ps in pspecs.items() if key in shapes
+                    for d, ax in enumerate(ps) if ax == "model"}
+            sizes = {shapes[key].shape[d] for key, d in dims.items()}
+            if not dims or len(sizes) != 1:
+                return "mixed/absent 'model' dims"
+            orig = sizes.pop()
+            if orig < tp_size:
+                return f"'model' dim {orig} < tp {tp_size}"
+            return {key: (d, orig) for key, d in dims.items()}
+
+        for lo, hi in ranges:
+            sharded: Dict[int, int] = {}   # node -> orig trailing width
+            for li in range(lo, hi):
+                spec, layer = g.layers[li], self.layers[li]
+                ent: Dict[str, Any] = {}
+                in_sh = {pos: sharded[ni]
+                         for pos, ni in enumerate(spec.nindex_in)
+                         if ni in sharded}
+                if in_sh:
+                    can_follow = (len(spec.nindex_in) == 1
+                                  and len(spec.nindex_out) == 1
+                                  and not spec.is_shared
+                                  and layer.tp_followable(train))
+                    if can_follow:
+                        orig = in_sh[0]
+                        if layer.tp_channel_params:
+                            ent["params"] = {k: (0, orig)
+                                             for k in layer.tp_channel_params}
+                        if layer.tp_channel_state and layer.has_state:
+                            ent["state"] = {k: orig
+                                            for k in layer.tp_channel_state}
+                        if getattr(layer, "pp_batch_stats", False):
+                            ent["sink_gather"] = orig
+                        ent["out_sharded"] = orig
+                        sharded[spec.nindex_out[0]] = orig
+                        followed.append(layer.name)
+                        plan[li] = ent
+                        continue
+                    ent["gather"] = dict(in_sh)
+                    for pos in in_sh:
+                        sharded.pop(spec.nindex_in[pos], None)
+                if not spec.is_shared and layer.has_params:
+                    dims = slice_dims(li, layer)
+                    if isinstance(dims, str):
+                        excluded.append((layer.name, dims))
+                    else:
+                        orig = next(iter(dims.values()))[1]
+                        ent["params"] = dims
+                        ent["out_sharded"] = orig
+                        sharded[spec.nindex_out[0]] = orig
+                if ent:
+                    plan[li] = ent
         # layers outside the plan compute replicated — say so once, loudly
         # enough to explain a flat memory/throughput curve, quiet enough
         # not to spam (grouped by reason, a few example names each)
-        if excluded and not self._tp_plan_logged:
+        if not self._tp_plan_logged:
             self._tp_plan_logged = True
             by_reason: Dict[str, List[str]] = {}
             for n, why in excluded:
@@ -366,7 +428,8 @@ class Network:
                 for why, names in by_reason.items())
             print(f"tp_manual_plan: {len(excluded)}/{len(self.layers)} "
                   f"layer(s) compute replicated across the model axis "
-                  f"(tp={tp_size}) — {detail}")
+                  f"(tp={tp_size}); {len(followed)} follow channel-sharded"
+                  + (f" — {detail}" if detail else ""))
         return plan
 
     def apply_stage(self, lo: int, hi: int, params: Params, seed,
@@ -374,7 +437,7 @@ class Network:
                     state: Optional[NetState] = None,
                     tp_axis: Optional[str] = None,
                     tp_size: int = 1,
-                    tp_plan: Optional[Dict[str, Dict[str, int]]] = None,
+                    tp_plan: Optional[Dict[int, Dict[str, Any]]] = None,
                     want: Optional[List[int]] = None,
                     seq_axis: Optional[str] = None,
                     data_axis: Optional[str] = None):
@@ -396,6 +459,33 @@ class Network:
             nodes[0] = seed
         sink: Dict[str, Any] = {}
         tp_plan = tp_plan or {}
+        sharded: Dict[int, int] = {}   # node -> orig trailing width
+
+        def slice_leaf(leaf, d, orig, me):
+            """This shard's span of ``leaf`` along dim ``d``: zero-pad a
+            non-divisible dim to a tp multiple first — pad rows/channels
+            compute zeros that the eventual gather trims, and the
+            pad+dynamic_slice pair transposes to exact zero-padded-slice
+            gradients under autodiff."""
+            span = -(-orig // tp_size)
+            if span * tp_size != orig:
+                pw = [(0, 0)] * leaf.ndim
+                pw[d] = (0, span * tp_size - orig)
+                leaf = jnp.pad(leaf, pw)
+            return jax.lax.dynamic_slice_in_dim(leaf, me * span, span,
+                                                axis=d)
+
+        def gather_trim(v, orig):
+            """Deferred manual-tp all-gather on the trailing channel axis,
+            trimmed back to the original width (padding case) — a
+            model-group-scoped collective every model peer of this stage
+            executes (see tp_manual_plan)."""
+            full = jax.lax.all_gather(v, tp_axis, axis=v.ndim - 1,
+                                      tiled=True)
+            if full.shape[-1] != orig:
+                full = jax.lax.slice_in_dim(full, 0, orig, axis=-1)
+            return full
+
         for li in range(lo, hi):
             spec, layer = g.layers[li], self.layers[li]
             # seq/data axes bound under the sequence-parallel pipeline:
@@ -407,32 +497,51 @@ class Network:
                            stat_sink=sink if train else None,
                            seq_axis=seq_axis, data_axis=data_axis,
                            seq_gather_kv=seq_axis is not None)
+            ent = tp_plan.get(li)
+            if ent:
+                # first channel-mixing consumer of a sharded chain:
+                # materialize the full activation here
+                for pos, orig in ent.get("gather", {}).items():
+                    ni = spec.nindex_in[pos]
+                    if ni in sharded:
+                        nodes[ni] = gather_trim(nodes[ni], sharded.pop(ni))
             inputs = [nodes[ni] for ni in spec.nindex_in]
             lstate = (state or {}).get(layer.name, {})
             lparams = params.get(layer.name, {})
-            dims = tp_plan.get(layer.name)
-            if dims:
-                # manual tensor parallelism: this model shard computes a
-                # slice of the output channels with its weight slice, then
-                # all-gathers — a model-group-scoped collective that every
-                # model peer of this stage executes (see tp_manual_plan)
+            if ent and ("params" in ent or "state" in ent):
                 me = jax.lax.axis_index(tp_axis)
-                lparams = dict(lparams)
-                for key, d in dims.items():
-                    leaf = lparams[key]
-                    span = leaf.shape[d] // tp_size
-                    lparams[key] = jax.lax.dynamic_slice_in_dim(
-                        leaf, me * span, span, axis=d)
+                if "params" in ent:
+                    lparams = dict(lparams)
+                    for key, (d, orig) in ent["params"].items():
+                        lparams[key] = slice_leaf(lparams[key], d, orig, me)
+                if "state" in ent and lstate:
+                    lstate = dict(lstate)
+                    for key, orig in ent["state"].items():
+                        lstate[key] = slice_leaf(lstate[key], 0, orig, me)
             outputs, _ = layer.apply(lparams, lstate, inputs, ctx)
-            if dims:
-                ax = layer.tp_manual_axis % outputs[0].ndim
-                outputs = [jax.lax.all_gather(outputs[0], tp_axis,
-                                              axis=ax, tiled=True)]
+            if ent and "sink_gather" in ent and layer.name in sink:
+                # batch-stat followers (BN) computed channel-local moments;
+                # gather them back to full width so the trainer's post-ring
+                # merge and the stats_sd probe see the unsharded shape
+                sink[layer.name] = jax.tree_util.tree_map(
+                    lambda v: gather_trim(v, ent["sink_gather"]),
+                    sink[layer.name])
+            if ent and "out_sharded" in ent:
+                sharded[spec.nindex_out[0]] = ent["out_sharded"]
             for ni, out in zip(spec.nindex_out, outputs):
                 nodes[ni] = out
+        # stage end: every value leaving the stage (ring register, capture
+        # banks, tail seeds) gathers to full width — sharded values never
+        # cross stage boundaries (tp_manual_plan resets its walk per stage)
         if want is not None:
-            return {ni: nodes[ni] for ni in want}, sink
-        return nodes[g.layers[hi - 1].nindex_out[0]], sink
+            return {ni: (gather_trim(nodes[ni], sharded[ni])
+                         if ni in sharded else nodes[ni])
+                    for ni in want}, sink
+        ni = g.layers[hi - 1].nindex_out[0]
+        out = nodes[ni]
+        if ni in sharded:
+            out = gather_trim(out, sharded[ni])
+        return out, sink
 
     def apply_tail(self, body_hi: int, params: Params, state: NetState,
                    seeds: Dict[int, jax.Array],

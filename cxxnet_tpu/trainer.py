@@ -819,7 +819,7 @@ class Trainer:
             return out
         pipe_axis, data_axis = self.mesh.pipe_axis, self.mesh.data_axis
         model_axis, tp = self.mesh.model_axis, self.mesh.model_parallel
-        tp_plan = net.tp_manual_plan(tp)
+        tp_plan = net.tp_manual_plan(tp, stage_ranges=ranges, train=train)
         tp_kw = dict(tp_axis=model_axis, tp_size=tp, tp_plan=tp_plan)
         M = self._pp_microbatch
         sp = self._sp
@@ -1015,7 +1015,7 @@ class Trainer:
         top_name = self.graph.node_names[
             self.graph.layers[-1].nindex_out[0]]
         captured = tuple(n for n in needed if n != top_name)
-        pipeline, out_sd, tp_plan, node_sds = self._pp_pipeline_fn(
+        pipeline, out_sd, _, node_sds = self._pp_pipeline_fn(
             data_shape, train=True, capture=captured)
         bn_ema = self._pp_bn_momenta()
         # per-step deterministic state advances (insanity's annealing

@@ -355,11 +355,18 @@ def test_pipeline_composes_with_tensor_parallel():
                     mesh_ctx=_pp_mesh(pp=2, dp=2))
     tr_tp.init_model()
     tr_pp.init_model()
-    # the manual plan covers the fc weights (24 divides by tp=2)
+    # the manual plan: fc1 slices its output dim, bn1/relu FOLLOW the
+    # channel-sharded activation (deferred gather), fc2 gathers its input
+    # before slicing its own, and fc3's indivisible nhidden=5 plans via
+    # zero-padding instead of falling back to replicated
     plan = tr_tp.net.tp_manual_plan(2)
-    assert plan.get("fc1") == {"wmat": 1, "bias": 0}
-    assert "fc2" in plan
-    assert "fc3" not in plan      # nhidden=5 indivisible -> replicated
+    assert plan[0]["params"] == {"wmat": (1, 24), "bias": (0, 24)}
+    assert plan[0]["out_sharded"] == 24
+    assert plan[1]["params"] == {"wmat": (0, 24), "bias": (0, 24)}
+    assert plan[1]["sink_gather"] == 24          # bn1 moments re-gather
+    assert plan[2]["out_sharded"] == 24          # relu follows
+    assert plan[3]["gather"] == {0: 24}          # fc2 mixes channels
+    assert plan[6]["params"]["wmat"] == (1, 5)   # fc3 pads 5 -> 6
     it = create_iterator(parse_config_string(PP_ITER))
     losses_tp, losses_pp = [], []
     for b in it:
@@ -373,6 +380,101 @@ def test_pipeline_composes_with_tensor_parallel():
     err_tp = float(tr_tp.evaluate(it, "e").split(":")[-1])
     err_pp = float(tr_pp.evaluate(it, "e").split(":")[-1])
     assert abs(err_tp - err_pp) < 0.05
+
+PP_CONV_TP_CFG = """
+netconfig=start
+layer[+1:c1] = conv:cv1
+  kernel_size = 3
+  nchannel = 7
+  pad = 1
+  random_type = xavier
+layer[+1:b1] = batch_norm:bn1
+layer[+1:a1] = relu
+layer[+1:p1] = max_pooling
+  kernel_size = 2
+  stride = 2
+layer[+1:c2] = conv:cv2
+  kernel_size = 3
+  nchannel = 8
+  pad = 1
+  random_type = xavier
+  stage = 1
+layer[+1:b2] = batch_norm:bn2
+layer[+1:a2] = relu
+layer[+1:f1] = flatten
+layer[f1->out] = fullc:fc
+  nhidden = 5
+  random_type = xavier
+layer[+0] = softmax
+netconfig=end
+input_shape = 3,8,8
+batch_size = 32
+eta = 0.1
+momentum = 0.9
+metric = error
+eval_train = 0
+"""
+
+PP_CONV7_ITER = """
+iter = synthetic
+num_inst = 128
+batch_size = 32
+num_class = 5
+input_shape = 3,8,8
+seed_data = 13
+"""
+
+
+def test_pp_tp_conv_follow_chain_matches():
+    """pp x tp on a CONV net with ODD channel counts: the conv slices via
+    zero-padding (7 -> 8, tp=2), BN/relu/pooling FOLLOW the
+    channel-sharded activation (the all-gather lands at the next conv /
+    flatten, not after every layer), BN's sink moments re-gather, and
+    eval reads channel-sliced running stats. Must match the tp=1
+    pipeline run exactly — tp is an execution strategy."""
+    cfg = parse_config_string(PP_CONV_TP_CFG)
+    devs = jax.devices()
+    ctx_tp = make_mesh_context(devices=devs, pipeline_parallel=2,
+                               model_parallel=2)
+    tr_tp = Trainer(cfg + [("pipeline_microbatch", "4")], mesh_ctx=ctx_tp)
+    tr_pp = Trainer(cfg + [("pipeline_microbatch", "4")],
+                    mesh_ctx=_pp_mesh(pp=2, dp=2))
+    tr_tp.init_model()
+    tr_pp.init_model()
+    # the plan: cv1 slices (padded), bn1/relu/pool follow, cv2 gathers
+    plan = tr_tp.net.tp_manual_plan(
+        2, stage_ranges=tr_tp.net.stage_partition(2))
+    assert plan[0]["params"]["wmat"] == (3, 7)
+    assert plan[1]["sink_gather"] == 7           # bn1 follows, re-gathers
+    assert plan[2]["out_sharded"] == 7           # relu follows
+    assert plan[3]["out_sharded"] == 7           # max_pooling follows
+    # cv2 heads stage 1: the pool output gathers at the stage boundary
+    # (ring register carries full values), so cv2 sees a full input and
+    # just slices its own output; flatten is where stage 1's chain lands
+    assert "gather" not in plan[4]
+    assert plan[4]["params"]["wmat"] == (3, 8)
+    assert plan[7]["gather"] == {0: 8}           # flatten mixes layout
+    it = create_iterator(parse_config_string(PP_CONV7_ITER))
+    losses_tp, losses_pp = [], []
+    for _ in range(2):
+        for b in it:
+            tr_tp.update(b)
+            losses_tp.append(float(tr_tp.last_loss))
+        for b in it:
+            tr_pp.update(b)
+            losses_pp.append(float(tr_pp.last_loss))
+    np.testing.assert_allclose(losses_tp, losses_pp, rtol=5e-4)
+    # BN running stats went through the channel-sharded sink + re-gather
+    for bn in ("bn1", "bn2"):
+        for k in ("running_exp", "running_var"):
+            np.testing.assert_allclose(
+                np.asarray(tr_tp.net_state[bn][k]),
+                np.asarray(tr_pp.net_state[bn][k]), rtol=1e-4, atol=1e-6)
+    # eval reads CHANNEL-SLICED running stats through the stages
+    err_tp = float(tr_tp.evaluate(it, "e").split(":")[-1])
+    err_pp = float(tr_pp.evaluate(it, "e").split(":")[-1])
+    assert abs(err_tp - err_pp) < 0.05
+
 
 MOE_LM_CFG = f"""
 netconfig=start
