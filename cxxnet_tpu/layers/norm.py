@@ -93,17 +93,30 @@ class _BatchNormBase(Layer):
         slope, bias = params["wmat"], params["bias"]
         if ctx.train:
             xf = x.astype(jnp.float32)
+            # ONE-PASS moments: E[x^2]-E[x]^2 instead of the two-pass
+            # E[(x-mean)^2]. The two-pass form makes the variance
+            # reduction DEPEND on the mean, forcing XLA to read the conv
+            # output twice; sibling independent reductions fuse into one
+            # multi-output kernel (one read). The step is HBM-bound
+            # (doc/bytes_audit.md), so the saved read is real throughput.
+            # Tradeoff: f32 cancellation loses variance precision when
+            # |mean| >> std (error ~1e-7 x mean^2 absolute); acceptable
+            # for post-conv activations, and the clamp guards the
+            # tiny-negative case, but a pathological large-mean/low-var
+            # channel degrades toward inv = rsqrt(eps).
             mean = jnp.mean(xf, axis=axes)
-            var = jnp.mean(jnp.square(xf - mean), axis=axes)
+            ex2 = jnp.mean(jnp.square(xf), axis=axes)
+            var = jnp.maximum(ex2 - jnp.square(mean), 0.0)
             inv = jax.lax.rsqrt(var + self.eps)
             out = (x - mean) * inv * slope + bias
             if self.moving_avg:
                 if ctx.stat_sink is not None:
                     # pipeline body: hand raw moments to the schedule (the
                     # trainer merges an exact full-batch EMA update after
-                    # the ring); state is untouched here
-                    ctx.stat_sink[self.name] = {
-                        "mean": mean, "sq": var + jnp.square(mean)}
+                    # the ring); state is untouched here. Sink the TRUE
+                    # second moment (not var+mean^2, which the clamp
+                    # would have distorted)
+                    ctx.stat_sink[self.name] = {"mean": mean, "sq": ex2}
                 else:
                     m = self.bn_momentum
                     state = {
@@ -118,7 +131,9 @@ class _BatchNormBase(Layer):
         else:
             xf = x.astype(jnp.float32)
             mean = jnp.mean(xf, axis=axes)
-            var = jnp.mean(jnp.square(xf - mean), axis=axes)
+            var = jnp.maximum(
+                jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean),
+                0.0)
         inv = jax.lax.rsqrt(var + self.eps)
         out = x * (slope * inv) + (bias - slope * mean * inv)
         return [out.astype(x.dtype)], state

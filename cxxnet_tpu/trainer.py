@@ -1085,7 +1085,12 @@ class Trainer:
                 new_state = dict(net_state)
                 for name, mom in bn_ema.items():
                     mean = stats[name]["mean"] / M
-                    var = stats[name]["sq"] / M - jnp.square(mean)
+                    # same tiny-negative cancellation guard as the BN
+                    # layer's one-pass moments (layers/norm.py) — an
+                    # unclamped -1e-8 here would EMA running_var
+                    # negative and NaN the eval rsqrt
+                    var = jnp.maximum(
+                        stats[name]["sq"] / M - jnp.square(mean), 0.0)
                     st = net_state[name]
                     new_state[name] = {
                         "running_exp": st["running_exp"] * mom
