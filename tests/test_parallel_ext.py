@@ -400,7 +400,7 @@ layer[+1:c2] = conv:cv2
   random_type = xavier
   stage = 1
 layer[+1:b2] = batch_norm:bn2
-layer[+1:a2] = relu
+layer[+1:a2] = prelu:pr2
 layer[+1:f1] = flatten
 layer[f1->out] = fullc:fc
   nhidden = 5
@@ -429,9 +429,11 @@ def test_pp_tp_conv_follow_chain_matches():
     """pp x tp on a CONV net with ODD channel counts: the conv slices via
     zero-padding (7 -> 8, tp=2), BN/relu/pooling FOLLOW the
     channel-sharded activation (the all-gather lands at the next conv /
-    flatten, not after every layer), BN's sink moments re-gather, and
-    eval reads channel-sliced running stats. Must match the tp=1
-    pipeline run exactly — tp is an execution strategy."""
+    flatten, not after every layer), BN's sink moments re-gather, prelu
+    follows with its per-channel slope SLICED (and its grads routed
+    through the pad+slice transpose), and eval reads channel-sliced
+    running stats. Must match the tp=1 pipeline run exactly — tp is an
+    execution strategy."""
     cfg = parse_config_string(PP_CONV_TP_CFG)
     devs = jax.devices()
     ctx_tp = make_mesh_context(devices=devs, pipeline_parallel=2,
