@@ -257,13 +257,13 @@ class LearnTask:
             end_round = min(end_round, self.start_counter + self.max_round)
         self._end_round = end_round
         chain = self.train_chain if self.train_chain > 1 else 0
-        if chain and (tr.update_period > 1
-                      or tr.mesh.pipeline_parallel > 1):
+        if chain and (tr.mesh.pipeline_parallel > 1
+                      or (tr.update_period > 1
+                          and tr.mesh.seq_parallel > 1)):
             raise ValueError(
-                "train_chain requires update_period = 1 and a "
-                "non-pipeline mode — chained steps compose with "
-                "dp/tp/sp (and capture train metrics) but not with "
-                "accumulation or pp")
+                "train_chain composes with dp/tp/sp, train metrics, "
+                "and (std-mode) update_period accumulation — but not "
+                "with pp, nor with accumulation under sp")
         for r in range(self.start_counter, end_round):
             tr.start_round(r)
             batch_count = 0

@@ -148,6 +148,7 @@ class Trainer:
         self._last_loss = None
         self._sched_cache = None
         self._sched_stack_cache = None
+        self._cnt_cache = None
         self._mask_cache = None
         self._sp_label_cache = None
         self._rng_key = None
@@ -1213,15 +1214,20 @@ class Trainer:
         needed = self._needed_nodes() if (bank or not chain) else []
         capture = bool(needed)
 
-        def one(params, opt_state, net_state, accum, data, label, mask,
-                extra, rng, sched):
+        def fwd_bwd(params, net_state, data, label, mask, extra, rng):
+            # ONE forward/backward body shared by the plain and the
+            # accumulating chain step — keeps the two numerically locked
             def loss_fn(p):
                 res = net.apply(p, net_state, data, label, mask,
                                 extra_data=extra, rng=rng, train=True,
                                 capture_nodes=capture)
                 return res.loss, (res.state, _collect_nodes(res, needed))
-            (loss, (new_state, nodes)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
+            return jax.value_and_grad(loss_fn, has_aux=True)(params)
+
+        def one(params, opt_state, net_state, accum, data, label, mask,
+                extra, rng, sched):
+            (loss, (new_state, nodes)), grads = fwd_bwd(
+                params, net_state, data, label, mask, extra, rng)
             params, opt_state, accum = _apply_grads(
                 opt, period, do_update, params, opt_state, accum, grads,
                 sched)
@@ -1229,6 +1235,48 @@ class Trainer:
             return (params, opt_state, new_state, accum, loss, nodes,
                     jax.random.fold_in(rng, 1))
 
+        if chain and multi and period > 1:
+            # gradient accumulation INSIDE the chain (the reference's
+            # update_period memory recipe, e.g. AlexNet's batch-256 via
+            # 2 x 128): the accumulator and the sample counter ride the
+            # scan carry, and the optimizer applies under lax.cond on
+            # the period boundary — chains need not align with periods
+            def one_acc(p, o, s, a, c, d, l, m, e, r, sc):
+                (loss, (new_state, nodes)), grads = fwd_bwd(
+                    p, s, d, l, m, e, r)
+                a = jax.tree_util.tree_map(jnp.add, a, grads)
+
+                def apply_fn(args):
+                    p_, o_, a_, sc_ = args
+                    scaled = jax.tree_util.tree_map(
+                        lambda g: g / period, a_)
+                    p_, o_ = opt.update(p_, scaled, o_, sc_)
+                    return p_, o_, jax.tree_util.tree_map(
+                        jnp.zeros_like, a_)
+                p, o, a = jax.lax.cond(
+                    (c + 1) % period == 0, apply_fn,
+                    lambda args: (args[0], args[1], args[2]),
+                    (p, o, a, sc))
+                return (p, o, new_state, a, c + 1, loss, nodes,
+                        jax.random.fold_in(r, 1))
+
+            def step(params, opt_state, net_state, accum, cnt0, data,
+                     label, mask, extra, rng, sched):
+                def sbody(carry, xs):
+                    p, o, s, a, c, r = carry
+                    d, l, m, e, sc = xs
+                    p, o, s, a, c, loss, nodes, r = one_acc(
+                        p, o, s, a, c, d, l, m, e, r, sc)
+                    return (p, o, s, a, c, r), (loss,
+                                                nodes if bank else {})
+                (params, opt_state, net_state, accum, _c, rng), \
+                    (losses, nodes) = jax.lax.scan(
+                        sbody,
+                        (params, opt_state, net_state, accum, cnt0, rng),
+                        (data, label, mask, extra, sched))
+                return (params, opt_state, net_state, losses, nodes,
+                        accum, rng)
+            return jax.jit(step, donate_argnums=(0, 1, 2, 3))
         if chain and multi:
             # sched arrives stacked (k,) per tag — per-step LR/momentum
             # ride the scan xs, so chained training follows the same
@@ -1309,20 +1357,22 @@ class Trainer:
         small models on remote-attached chips (task driver knob
         ``train_chain = k``). Same math as k sequential ``update()``
         calls: per-batch padding masks apply, the rng chains per step,
-        per-step LR/momentum schedule values ride the scan, and with
+        per-step LR/momentum schedule values ride the scan, with
         ``eval_train`` the per-step metric nodes bank through the scan
-        ys (fetched lazily, like update()'s deferred metric). std
-        (dp/tp) and sp modes; no gradient accumulation (pp models are
-        dispatch-floor-irrelevant — their steps are tens of ms)."""
+        ys (fetched lazily, like update()'s deferred metric), and in
+        std mode ``update_period`` accumulation rides the scan carry
+        (chains need not align with period boundaries). std (dp/tp)
+        and sp modes; no accumulation under sp, and no pp (pp models
+        are dispatch-floor-irrelevant — their steps are tens of ms)."""
         assert self.params is not None, "call init_model() first"
         k = len(batches)
         if k == 0:
             raise ValueError("update_chain_batches: empty batch list")
         if self._pp > 1:
             raise ValueError("update_chain_batches: std/sp modes only")
-        if self.update_period > 1:
+        if self.update_period > 1 and self._sp > 1:
             raise ValueError("update_chain_batches: update_period "
-                             "accumulation does not chain")
+                             "accumulation chains in std mode only")
         from jax.sharding import PartitionSpec as P
         da, sa = self.mesh.data_axis, self.mesh.seq_axis
 
@@ -1384,19 +1434,40 @@ class Trainer:
             key = ("chainb", k, n_extra, bool(self.eval_train))
             maker = lambda: self._make_train_step(True, chain=k,
                                                   multi=True)
+        period = self.update_period
+        if period > 1:
+            key = key + ("acc",)
         if key not in self._train_step_fns:
             self._train_step_fns[key] = maker()
         if self._rng_key is None:
             self._rng_key = jax.random.fold_in(self._base_key,
                                                self._step_count)
-        (self.params, self.opt_state, self.net_state, losses, nodes,
-         self._rng_key) = self._train_step_fns[key](
-             self.params, self.opt_state, self.net_state, data, label,
-             masks, *args_extra, self._rng_key, self._sched_stack(k))
+        sched = self._sched_stack(k)
+        if period > 1:
+            # accumulator + sample counter thread through the chain so
+            # period boundaries need not align with chain boundaries
+            # counter scalar cached by value (usually 0 when chains
+            # align with periods) — same no-reupload idiom as
+            # _sched_scalars
+            if self._cnt_cache is None \
+                    or self._cnt_cache[0] != self.sample_counter:
+                self._cnt_cache = (self.sample_counter,
+                                   jnp.int32(self.sample_counter))
+            (self.params, self.opt_state, self.net_state, losses, nodes,
+             self.accum, self._rng_key) = self._train_step_fns[key](
+                 self.params, self.opt_state, self.net_state, self.accum,
+                 self._cnt_cache[1], data, label, masks,
+                 *args_extra, self._rng_key, sched)
+        else:
+            (self.params, self.opt_state, self.net_state, losses, nodes,
+             self._rng_key) = self._train_step_fns[key](
+                 self.params, self.opt_state, self.net_state, data,
+                 label, masks, *args_extra, self._rng_key, sched)
         self._last_loss = losses[-1]
         self._step_count += k
-        self.sample_counter = 0
-        self.epoch_counter += k
+        total = self.sample_counter + k
+        self.sample_counter = total % period
+        self.epoch_counter += total // period
         if self.eval_train and nodes:
             self._drain_pending_metric()
             self._pending_metric = (nodes, list(batches))
@@ -1417,11 +1488,15 @@ class Trainer:
 
     def _sched_stack(self, k: int):
         """Per-step schedule values for a k-step chain, stacked (k,) per
-        tag — step i of the chain sees schedules(epoch_counter + i),
-        exactly what k sequential update() calls would. Cached by value
-        (constant schedules re-use one device upload)."""
-        scheds = [self.optimizer.schedules(self.epoch_counter + i)
-                  for i in range(k)]
+        tag — step i of the chain sees the schedule of the epoch counter
+        it would have under k sequential update() calls (the counter
+        advances once per APPLIED update, i.e. every update_period
+        steps). Cached by value (constant schedules re-use one device
+        upload)."""
+        per = self.update_period
+        scheds = [self.optimizer.schedules(
+            self.epoch_counter + (self.sample_counter + i) // per)
+            for i in range(k)]
         key = tuple(sorted(
             (tag,) + tuple(v for s in scheds for v in s[tag])
             for tag in scheds[0]))

@@ -575,6 +575,31 @@ def test_update_chain_batches_train_metrics_match(mesh8):
     assert line_c == line_s
 
 
+def test_update_chain_batches_accumulates_update_period(mesh8):
+    """update_period=2 composes with chains (the reference's AlexNet
+    batch-256 memory recipe, example/ImageNet/README.md:6-10): the
+    accumulator and sample counter ride the scan carry, the optimizer
+    applies on period boundaries under lax.cond, and chains need NOT
+    align with periods — a 3-step chain + a 3-step chain over period 2
+    must reproduce 6 sequential update() calls exactly."""
+    extra = "update_period = 2\n"
+    tr_c = make_trainer(mesh8, extra=extra)
+    tr_s = make_trainer(mesh8, extra=extra)
+    batches = list(synth_iter())[:6]
+    tr_c.update_chain_batches(batches[:3])   # period boundary mid-chain
+    tr_c.update_chain_batches(batches[3:])
+    for b in batches:
+        tr_s.update(b)
+    assert tr_c.epoch_counter == tr_s.epoch_counter == 3
+    assert tr_c.sample_counter == tr_s.sample_counter == 0
+    np.testing.assert_allclose(tr_c.get_weight("fc1", "wmat"),
+                               tr_s.get_weight("fc1", "wmat"),
+                               rtol=1e-5, atol=1e-6)
+    # train metrics still bank per step through the accumulating chain
+    assert tr_c.train_metric_report("train") == \
+        tr_s.train_metric_report("train")
+
+
 def test_update_chain_batches_follows_lr_schedule(mesh8):
     """Per-step LR/momentum values ride the chain scan: with a
     per-update factor schedule the chained weights must match k
