@@ -400,8 +400,25 @@ def e2e_bench(tr, image, classes, batch, steps, device_normalize=0,
             return time.perf_counter() - t0, count
 
         # warm pass: page cache, decode pool, chain compile, and the
-        # post-donation relayout recompile all retire here
-        window(min(steps, 2 * max(chain, 1)))
+        # post-donation relayout recompile all retire here. The bench
+        # must never die to a chain issue on a new backend — fall back
+        # to per-batch dispatch (recorded as chain_fallback in the
+        # detail dict). A failed chain may have consumed the donated
+        # param/opt buffers mid-execution, so re-init before retrying
+        # (same recovery as compute_bench's fallback).
+        chain_fallback = False
+        try:
+            window(min(steps, 2 * max(chain, 1)))
+        except Exception as e:
+            if not chain:
+                raise
+            print(f"e2e chain dispatch unavailable "
+                  f"({type(e).__name__}: {e}); falling back to "
+                  f"per-batch update", file=sys.stderr)
+            chain = 0
+            chain_fallback = True
+            tr.init_model()
+            window(min(steps, 2))
         n2 = steps
         n1 = max(chain, steps // 3)
         if chain:                      # windows = whole chains
@@ -418,11 +435,14 @@ def e2e_bench(tr, image, classes, batch, steps, device_normalize=0,
             timing = (f"single {c2}-image window, value-synced "
                       f"(corpus too small for distinct slope windows)")
     n_chips = max(1, tr.mesh.num_devices)
-    return ips_raw / n_chips, {
+    detail = {
         "dispatch": (f"update_chain_batches k={chain}" if chain
                      else "per-batch update (prefetch double-buffered)"),
         "timing": timing,
     }
+    if chain_fallback:
+        detail["chain_fallback"] = True
+    return ips_raw / n_chips, detail
 
 
 def h2d_bench(image, batch):

@@ -70,6 +70,17 @@ def _chain_scan(one, length):
     return step
 
 
+def _apply_accum(opt, period, params, opt_state, accum, sched):
+    """The period-boundary apply: scale the accumulated grads, step the
+    optimizer, zero the accumulator. ONE definition shared by the
+    static (update) and traced (accumulating-chain lax.cond) callers so
+    the two paths cannot silently diverge."""
+    scaled = jax.tree_util.tree_map(lambda g: g / period, accum)
+    params, opt_state = opt.update(params, scaled, opt_state, sched)
+    return params, opt_state, jax.tree_util.tree_map(
+        jnp.zeros_like, accum)
+
+
 def _apply_grads(opt, period, do_update, params, opt_state, accum, grads,
                  sched):
     """Gradient accumulation (update_period) + optimizer step — shared by
@@ -77,9 +88,8 @@ def _apply_grads(opt, period, do_update, params, opt_state, accum, grads,
     if period > 1:
         accum = jax.tree_util.tree_map(jnp.add, accum, grads)
         if do_update:
-            scaled = jax.tree_util.tree_map(lambda g: g / period, accum)
-            params, opt_state = opt.update(params, scaled, opt_state, sched)
-            accum = jax.tree_util.tree_map(jnp.zeros_like, accum)
+            params, opt_state, accum = _apply_accum(
+                opt, period, params, opt_state, accum, sched)
     else:
         params, opt_state = opt.update(params, grads, opt_state, sched)
     return params, opt_state, accum
@@ -1246,15 +1256,10 @@ class Trainer:
                     p, s, d, l, m, e, r)
                 a = jax.tree_util.tree_map(jnp.add, a, grads)
 
-                def apply_fn(args):
-                    p_, o_, a_, sc_ = args
-                    scaled = jax.tree_util.tree_map(
-                        lambda g: g / period, a_)
-                    p_, o_ = opt.update(p_, scaled, o_, sc_)
-                    return p_, o_, jax.tree_util.tree_map(
-                        jnp.zeros_like, a_)
                 p, o, a = jax.lax.cond(
-                    (c + 1) % period == 0, apply_fn,
+                    (c + 1) % period == 0,
+                    lambda args: _apply_accum(opt, period, args[0],
+                                              args[1], args[2], args[3]),
                     lambda args: (args[0], args[1], args[2]),
                     (p, o, a, sc))
                 return (p, o, new_state, a, c + 1, loss, nodes,
