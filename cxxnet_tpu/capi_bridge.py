@@ -26,6 +26,7 @@ __all__ = [
     "net_update_batch", "net_predict_batch", "net_predict_iter",
     "net_extract_batch", "net_extract_iter", "net_evaluate",
     "net_get_weight", "net_set_weight",
+    "create_engine", "engine_predict", "engine_stats",
 ]
 
 
@@ -135,6 +136,33 @@ def net_get_weight(net: Net, layer: str, tag: str):
         return None
     w = np.ascontiguousarray(w, np.float32)
     return w.tobytes(), tuple(w.shape), int(w.ndim)
+
+
+# -- serving engine ----------------------------------------------------------
+
+def create_engine(net: Net, max_batch: int = 64, buckets: str = "",
+                  cache_size: int = 16):
+    """Engine handle over a net's trained params — gives the C side the
+    online-serving capability the reference C API stopped short of
+    (it shipped only offline CXNNetPredict*)."""
+    return net.create_engine(max_batch=int(max_batch),
+                             buckets=buckets or None,
+                             cache_size=int(cache_size))
+
+
+def engine_predict(engine, data, dshape, raw: int = 0):
+    """Predict on an NCHW float32 buffer; returns (bytes, shape).
+    raw=0: one class id per instance; raw=1: full top-node rows."""
+    x = _arr(data, dshape)
+    out = engine.predict_raw(x) if raw else engine.predict(x)
+    out = np.ascontiguousarray(out, np.float32)
+    return out.tobytes(), tuple(out.shape)
+
+
+def engine_stats(engine) -> str:
+    """The /statz snapshot as a JSON string (C-friendly)."""
+    import json
+    return json.dumps(engine.stats.snapshot())
 
 
 def net_set_weight(net: Net, data, size: int, layer: str, tag: str) -> None:

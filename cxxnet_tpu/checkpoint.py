@@ -70,7 +70,9 @@ def save_model(path: str, *, structure_sig: tuple, round_counter: int,
     stream.write_bytes_atomic(path, buf.getvalue())
 
 
-def load_model(path: str) -> Dict[str, Any]:
+def _load_groups(path: str, include_opt: bool):
+    """Shared checkpoint reader: with ``include_opt=False`` the ``opt/``
+    members are never even decompressed from the archive."""
     if stream.is_remote(path):
         # remote: one ranged read into memory, then unpack
         with stream.sopen(path, "rb") as f:
@@ -78,17 +80,39 @@ def load_model(path: str) -> Dict[str, Any]:
     else:
         src = path                   # local: let np.load stream members
     with np.load(src, allow_pickle=False) as z:
-        arrays = {k: z[k] for k in z.files}
+        arrays = {k: z[k] for k in z.files
+                  if include_opt or k == "__meta__"
+                  or not k.startswith("opt/")}
     meta = json.loads(bytes(arrays.pop("__meta__")).decode("utf-8"))
-    groups: Dict[str, Dict[str, np.ndarray]] = {"params": {}, "state": {}, "opt": {}}
+    groups: Dict[str, Dict[str, np.ndarray]] = {"params": {}, "state": {}}
+    if include_opt:
+        groups["opt"] = {}
     for k, v in arrays.items():
         head, _, rest = k.partition("/")
         groups.setdefault(head, {})[rest] = v
+    return meta, groups
+
+
+def load_model(path: str) -> Dict[str, Any]:
+    meta, groups = _load_groups(path, include_opt=True)
     return {
         "meta": meta,
         "params": _unflatten(groups["params"]) if groups["params"] else {},
         "state": _unflatten(groups["state"]) if groups["state"] else {},
         "opt": _unflatten(groups["opt"]) if groups["opt"] else None,
+    }
+
+
+def load_for_inference(path: str) -> Dict[str, Any]:
+    """Load a checkpoint for serving: params + layer state only — an
+    inference engine never steps the optimizer, and momentum buffers
+    would double the model's host/device bytes at load time
+    (serve/engine.py builds on this)."""
+    meta, groups = _load_groups(path, include_opt=False)
+    return {
+        "meta": meta,
+        "params": _unflatten(groups["params"]) if groups["params"] else {},
+        "state": _unflatten(groups["state"]) if groups["state"] else {},
     }
 
 

@@ -214,6 +214,8 @@ class LearnTask:
             self.task_extract()
         elif self.task == "get_weight":
             self.task_get_weight()
+        elif self.task == "serve":
+            self.task_serve()
         else:
             raise ValueError(f"unknown task {self.task!r}")
 
@@ -340,6 +342,51 @@ class LearnTask:
             if self.save_model and self.save_period \
                     and (r + 1) % self.save_period == 0:
                 tr.save_model(ckpt.model_path(self.model_dir, r))
+
+    def task_serve(self) -> None:
+        """Online inference endpoint (serve/): the request-driven analog
+        of the offline pred/pred_raw/extract task modes. Blocks until
+        SIGINT/SIGTERM, then drains the batcher before exiting."""
+        from .serve import InferenceEngine
+        from .serve.engine import restore_inference_state
+        from .serve.server import ServeServer
+        gp = lambda n, d: global_param(self.global_cfg, n, d)
+        # inference-only restore: params + layer state WITHOUT optimizer
+        # state (momentum buffers ~double device bytes; an engine never
+        # steps the optimizer) — NOT the training path's _init_model
+        model_path = None
+        if self.continue_training:
+            latest = self._agree_latest()
+            if latest is not None:
+                model_path = latest[1]
+        if model_path is None and self.model_in != "NULL":
+            model_path = self.model_in
+        if model_path is not None:
+            restore_inference_state(self.trainer, model_path)
+            if not self.silent:
+                print(f"serving model {model_path}", flush=True)
+        else:
+            self.trainer.init_model()
+            if not self.silent:
+                print("serve: no model_in/continue given — serving a "
+                      "RANDOMLY INITIALIZED model (smoke mode)",
+                      flush=True)
+        engine = InferenceEngine(
+            self.trainer,
+            buckets=gp("serve_buckets", "") or None,
+            max_batch=int(gp("serve_max_batch", "64")),
+            cache_size=int(gp("serve_cache_size", "16")))
+        srv = ServeServer(
+            engine,
+            port=int(gp("serve_port", "8080")),
+            host=gp("serve_host", "127.0.0.1"),
+            max_latency_ms=float(gp("serve_max_latency_ms", "5")),
+            max_queue_rows=int(gp("serve_queue_rows", "1024")),
+            default_timeout_ms=float(gp("serve_timeout_ms", "0")) or None,
+            log_interval_s=float(gp("serve_log_interval", "30")),
+            silent=bool(self.silent))
+        srv.start()
+        srv.serve_until_interrupt()
 
     def task_predict(self) -> None:
         tr = self.trainer

@@ -1,0 +1,26 @@
+"""Online inference subsystem: the serving analog of the training stack.
+
+The reference cxxnet ships inference only as offline task modes
+(``task = pred / pred_raw / extract_feature``, cxxnet_main.cpp); this
+subpackage applies the same ahead-of-time-compiled, device-resident
+philosophy to request-driven prediction:
+
+* :mod:`.engine`  — ``InferenceEngine``: frozen params + a bucketed LRU of
+  AOT-compiled predict executables (fixed shape buckets, one compile per
+  bucket, steady-state traffic never recompiles);
+* :mod:`.batcher` — ``MicroBatcher``: dynamic micro-batching queue that
+  amortizes per-call dispatch overhead into device batches, with
+  backpressure and per-request deadlines;
+* :mod:`.stats`   — ``ServingStats``: rolling QPS, latency percentiles,
+  batch-fill ratio, compile-cache hit/miss accounting;
+* :mod:`.server`  — stdlib ``http.server`` JSON front-end
+  (``/predict``, ``/extract``, ``/healthz``, ``/statz``).
+"""
+
+from .engine import InferenceEngine
+from .batcher import MicroBatcher, Backpressure, DeadlineExceeded
+from .stats import ServingStats
+from .server import ServeServer
+
+__all__ = ["InferenceEngine", "MicroBatcher", "Backpressure",
+           "DeadlineExceeded", "ServingStats", "ServeServer"]

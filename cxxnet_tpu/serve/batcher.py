@@ -1,0 +1,252 @@
+"""Dynamic micro-batching queue for the inference engine.
+
+Requests accumulate until ``max_batch`` rows or ``max_latency_ms``
+elapses, then dispatch as ONE device call (engine.run_padded) and the
+results scatter back to per-request futures. Pure-Python threading —
+the same worker/bounded-queue pattern as the IO pipeline's
+ThreadBufferIterator (io/proc.py) — with:
+
+* **backpressure**: a bounded row budget; ``submit`` raises
+  :class:`Backpressure` instead of queueing unboundedly;
+* **deadlines**: each request may carry ``timeout_ms``; requests whose
+  deadline passed by dispatch time are rejected with
+  :class:`DeadlineExceeded` rather than served stale.
+
+Requests of different output kinds (predict / raw / extract[node])
+cannot share a device call, so pending work is grouped per
+``(kind, node)`` and each group flushes independently.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .engine import InferenceEngine
+from .stats import ServingStats
+
+
+class Backpressure(RuntimeError):
+    """Queue row budget exhausted; retry later (HTTP 503)."""
+
+
+class DeadlineExceeded(TimeoutError):
+    """Request expired before its batch dispatched (HTTP 504)."""
+
+
+class _Request:
+    __slots__ = ("rows", "kind", "node", "future", "t_submit", "deadline")
+
+    def __init__(self, rows, kind, node, deadline):
+        self.rows = rows
+        self.kind = kind
+        self.node = node
+        self.future: Future = Future()
+        self.t_submit = time.perf_counter()
+        self.deadline = deadline          # perf_counter abs, or None
+
+
+class MicroBatcher:
+    def __init__(self, engine: InferenceEngine,
+                 max_batch: Optional[int] = None,
+                 max_latency_ms: float = 5.0,
+                 max_queue_rows: int = 1024,
+                 default_timeout_ms: Optional[float] = None,
+                 stats: Optional[ServingStats] = None):
+        self.engine = engine
+        self.stats = stats or engine.stats
+        # clamped to the engine's largest bucket: a dispatch bigger than
+        # the bucket ceiling could never run as one device call
+        self.max_batch = min(int(max_batch or engine.max_batch),
+                             engine.max_batch)
+        self.max_latency_s = max_latency_ms / 1e3
+        self.max_queue_rows = int(max_queue_rows)
+        self.default_timeout_ms = default_timeout_ms
+        self._q: "queue.Queue[_Request]" = queue.Queue()
+        self._rows_lock = threading.Lock()
+        self._queued_rows = 0
+        self._stop = threading.Event()
+        self._drain = True
+        self._thread = threading.Thread(target=self._worker, daemon=True,
+                                        name="serve-batcher")
+        self._thread.start()
+
+    # -- client side -----------------------------------------------------
+    def submit(self, data, kind: str = "predict",
+               node: Optional[str] = None,
+               timeout_ms: Optional[float] = None) -> Future:
+        """Enqueue one request; returns a Future resolving to the result
+        rows for this request (np.ndarray). Raises Backpressure when the
+        row budget is full."""
+        rows = self.engine._to_input(data)
+        if rows.shape[0] == 0:
+            raise ValueError("empty request")
+        if rows.shape[0] > self.max_batch:
+            raise ValueError(
+                f"request rows {rows.shape[0]} > max_batch "
+                f"{self.max_batch}; split client-side or call the engine "
+                "directly")
+        self.stats.record_request()
+        if timeout_ms is None:
+            timeout_ms = self.default_timeout_ms
+        deadline = (time.perf_counter() + timeout_ms / 1e3
+                    if timeout_ms else None)
+        req = _Request(rows, kind, node, deadline)
+        # stop-check + put under the SAME lock close() sets _stop under:
+        # otherwise a submit could pass the check, get preempted, and put
+        # after the worker's final drain — a future no one ever resolves
+        with self._rows_lock:
+            if self._stop.is_set():
+                raise RuntimeError("batcher is shut down")
+            if self._queued_rows + rows.shape[0] > self.max_queue_rows:
+                self.stats.record_reject("backpressure")
+                raise Backpressure(
+                    f"serve queue full ({self._queued_rows} rows "
+                    f">= {self.max_queue_rows})")
+            self._queued_rows += rows.shape[0]
+            self._q.put(req)
+        return req.future
+
+    def close(self, drain: bool = True, timeout: Optional[float] = 30.0
+              ) -> None:
+        """Stop the worker. ``drain=True`` serves everything already
+        queued first (graceful shutdown); ``drain=False`` rejects it."""
+        self._drain = drain
+        with self._rows_lock:             # see submit(): no put after stop
+            self._stop.set()
+        self._q.put(None)                 # wake the worker
+        self._thread.join(timeout=timeout)
+
+    # -- worker side -----------------------------------------------------
+    def _release(self, reqs: List[_Request]) -> None:
+        n = sum(r.rows.shape[0] for r in reqs)
+        with self._rows_lock:
+            self._queued_rows -= n
+
+    def _flush(self, reqs: List[_Request]) -> None:
+        """Reject expired requests, then dispatch the group in chunks of
+        at most ``max_batch`` rows (a group can overshoot when the append
+        that crossed the threshold was multi-row, and the drain path
+        flushes arbitrary backlogs)."""
+        self._release(reqs)
+        now = time.perf_counter()
+        live: List[_Request] = []
+        for r in reqs:
+            if r.deadline is not None and now > r.deadline:
+                self.stats.record_reject("deadline")
+                r.future.set_exception(DeadlineExceeded(
+                    "request expired before dispatch"))
+            else:
+                live.append(r)
+        chunk: List[_Request] = []
+        n_rows = 0
+        for r in live:
+            if chunk and n_rows + r.rows.shape[0] > self.max_batch:
+                self._dispatch(chunk)
+                chunk, n_rows = [], 0
+            chunk.append(r)
+            n_rows += r.rows.shape[0]
+        if chunk:
+            self._dispatch(chunk)
+
+    def _dispatch(self, live: List[_Request]) -> None:
+        """ONE device call for one chunk; scatter results to futures."""
+        rows = (live[0].rows if len(live) == 1
+                else np.concatenate([r.rows for r in live], axis=0))
+        try:
+            out = self.engine.run_padded(rows, live[0].kind, live[0].node)
+        except Exception as e:
+            for r in live:
+                self.stats.record_failure()
+                r.future.set_exception(e)
+            return
+        self.stats.record_batch(
+            n_requests=len(live), rows_real=rows.shape[0],
+            rows_bucket=self.engine.bucket_for(rows.shape[0]))
+        off = 0
+        t_done = time.perf_counter()
+        for r in live:
+            n = r.rows.shape[0]
+            r.future.set_result(out[off:off + n])
+            self.stats.record_done(t_done - r.t_submit)
+            off += n
+
+    def _worker(self) -> None:
+        # pending groups: (kind, node) -> (first_arrival_t, [requests])
+        pending: Dict[Tuple[str, Optional[str]],
+                      Tuple[float, List[_Request]]] = {}
+
+        def group_rows(reqs: List[_Request]) -> int:
+            return sum(r.rows.shape[0] for r in reqs)
+
+        def group_due(t0: float, reqs: List[_Request]) -> float:
+            """When this group must dispatch: the latency window end, or
+            earlier when a member's deadline would expire first — a
+            timeout_ms shorter than max_latency_ms must be SERVED on an
+            idle queue, not auto-rejected at the window. 1 ms early so
+            dispatch begins before the deadline passes."""
+            due = t0 + self.max_latency_s
+            dls = [r.deadline for r in reqs if r.deadline is not None]
+            if dls:
+                due = min(due, min(dls) - 1e-3)
+            return due
+
+        def flush_due(force: bool = False) -> None:
+            now = time.perf_counter()
+            for key in list(pending):
+                t0, reqs = pending[key]
+                if force or now >= group_due(t0, reqs) \
+                        or group_rows(reqs) >= self.max_batch:
+                    del pending[key]
+                    self._flush(reqs)
+
+        while True:
+            stopping = self._stop.is_set()
+            if pending:
+                t_next = min(group_due(t0, reqs)
+                             for t0, reqs in pending.values())
+                wait = max(0.0, t_next - time.perf_counter())
+            else:
+                wait = 0.1
+            try:
+                # once stopping, drain whatever is already queued without
+                # waiting — a flush may have consumed 0.4s+ while close()
+                # landed, leaving a tail of accepted requests behind the
+                # sentinel AND after it
+                req = self._q.get_nowait() if stopping \
+                    else self._q.get(timeout=wait)
+            except queue.Empty:
+                if stopping:                  # queue fully drained
+                    if self._drain:
+                        flush_due(force=True)
+                    else:
+                        err = RuntimeError("batcher shut down")
+                        for _t0, reqs in pending.values():
+                            self._release(reqs)
+                            for r in reqs:
+                                r.future.set_exception(err)
+                        pending.clear()
+                    break
+                flush_due()
+                continue
+            if req is None:                   # shutdown sentinel
+                continue                      # keep draining until Empty
+            if stopping and not self._drain:
+                self._release([req])
+                req.future.set_exception(RuntimeError("batcher shut down"))
+                continue
+            key = (req.kind, req.node)
+            t0, reqs = pending.get(key, (time.perf_counter(), []))
+            reqs.append(req)
+            pending[key] = (t0, reqs)
+            if group_rows(reqs) >= self.max_batch:
+                del pending[key]
+                self._flush(reqs)
+            else:
+                flush_due()
+        # post-loop: nothing pending survives (flushed or rejected above)

@@ -1,0 +1,288 @@
+"""InferenceEngine: frozen params + bucketed cache of compiled predictors.
+
+The training side compiles ONE train step per shape and reuses it for the
+whole run (trainer.py); serving traffic has no fixed shape, so the engine
+quantizes request batches onto a small set of power-of-two **shape
+buckets**, pads up to the bucket, and keeps an LRU of jit-compiled
+executables keyed by ``(bucket_rows, output_kind[, node])`` (the input
+shape is an engine-level constant). Steady
+state traffic therefore never recompiles: the cache-miss counter equals
+the number of distinct buckets exercised.
+
+Eval-mode rows are independent (batch_norm uses running stats at eval), so
+zero-padding rows up to the bucket cannot perturb the real rows — the
+padded tail is sliced off before results leave the engine.
+
+Supported parallelism: the std (GSPMD dp/tp) path. Sequence- and
+pipeline-parallel trainers are training-topology artifacts; serving them
+is a later PR (shard across ``parallel/mesh.py``).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..config import ConfigPairs, parse_config_string
+from ..trainer import Trainer
+from .. import checkpoint as ckpt
+from .stats import ServingStats
+
+# output kinds mirroring the three cxxnet offline task modes
+_KINDS = ("predict", "raw", "extract")
+
+
+def restore_inference_state(trainer: Trainer, model_path: str) -> None:
+    """Restore params + layer state onto ``trainer`` from a checkpoint
+    WITHOUT materializing optimizer state (momentum buffers would roughly
+    double the model's device bytes, and an engine never steps the
+    optimizer) — shared by InferenceEngine.from_checkpoint and the
+    ``task = serve`` driver branch."""
+    blob = ckpt.load_for_inference(model_path)
+    ckpt.check_structure(blob["meta"],
+                         trainer.graph.structure_signature())
+    trainer.params, trainer.net_state = trainer._place(
+        blob["params"], blob["state"])
+    trainer.round_counter = blob["meta"]["round"]
+    trainer.epoch_counter = blob["meta"]["epoch"]
+
+
+def _parse_buckets(val: Union[str, Sequence[int], None],
+                   max_batch: int, dp: int) -> List[int]:
+    """Bucket ladder: explicit comma list, or powers of two from the
+    data-parallel degree up to ``max_batch``."""
+    if val:
+        if isinstance(val, str):
+            buckets = sorted({int(x) for x in val.split(",") if x.strip()})
+        else:
+            buckets = sorted({int(x) for x in val})
+    else:
+        buckets = []
+        b = max(1, dp)
+        while b < max_batch:
+            buckets.append(b)
+            b *= 2
+        buckets.append(max_batch)
+    if not buckets or buckets[0] < 1:
+        raise ValueError(f"invalid serve buckets {buckets!r}")
+    for b in buckets:
+        if b % max(1, dp):
+            raise ValueError(
+                f"serve bucket {b} not divisible by data-parallel degree "
+                f"{dp} (pick buckets that tile the mesh)")
+    return buckets
+
+
+class InferenceEngine:
+    """Wrap a trained :class:`Trainer` into a frozen predict service.
+
+    ``predict`` / ``predict_raw`` / ``extract`` match the three cxxnet
+    task modes (pred / pred_raw / extract_feature). Thread-safe: the
+    compile cache takes a lock; jitted calls themselves are re-entrant.
+    """
+
+    def __init__(self, trainer: Trainer,
+                 buckets: Union[str, Sequence[int], None] = None,
+                 max_batch: int = 64, cache_size: int = 16,
+                 stats: Optional[ServingStats] = None,
+                 layout: str = "NCHW"):
+        if trainer.params is None:
+            raise ValueError("trainer has no params: init_model()/"
+                             "load_model() before wrapping")
+        if trainer.mesh.seq_parallel > 1 or trainer.mesh.pipeline_parallel > 1:
+            raise ValueError("serve: std (dp/tp) trainers only; sp/pp "
+                             "serving is not supported")
+        if trainer.graph.extra_data_num:
+            raise ValueError("serve: graphs with extra_data are not "
+                             "servable (single-input requests)")
+        self.trainer = trainer
+        self.stats = stats or ServingStats()
+        self.layout = layout
+        dp = trainer.mesh.data_parallel
+        self.max_batch = int(max_batch)
+        self.buckets = _parse_buckets(buckets, self.max_batch, dp)
+        if self.buckets[-1] > self.max_batch:
+            # max_batch is the operator's per-dispatch memory/latency
+            # cap; a bucket above it would silently raise that cap
+            raise ValueError(
+                f"serve bucket {self.buckets[-1]} exceeds max_batch "
+                f"{self.max_batch}; raise serve_max_batch or drop the "
+                "bucket")
+        if self.max_batch > self.buckets[-1]:
+            # an explicit ladder must still honor max_batch: the batcher
+            # sizes dispatches up to max_batch, and a dispatch larger
+            # than the top bucket could never run as one device call
+            if self.max_batch % max(1, dp):
+                raise ValueError(
+                    f"serve max_batch {self.max_batch} not divisible by "
+                    f"data-parallel degree {dp}")
+            self.buckets.append(self.max_batch)
+        self.input_shape = tuple(trainer.graph.input_shape)  # (c, y, x)
+        self._cache: "OrderedDict[tuple, object]" = OrderedDict()
+        self._cache_cap = int(cache_size)
+        if self._cache_cap < 1:
+            raise ValueError(
+                f"serve cache_size must be >= 1, got {self._cache_cap}")
+        self._lock = threading.Lock()
+        self.stats.record_cache(size=0, capacity=self._cache_cap)
+
+    # -- construction ----------------------------------------------------
+    @classmethod
+    def from_checkpoint(cls, cfg: Union[str, ConfigPairs], model_path: str,
+                        **kw) -> "InferenceEngine":
+        """Build a trainer from a net config and restore inference state
+        from ``model_path`` WITHOUT materializing optimizer state
+        (checkpoint.load_for_inference) — an engine never steps the
+        optimizer, and momentum buffers double a model's device bytes."""
+        pairs = parse_config_string(cfg) if isinstance(cfg, str) \
+            else list(cfg)
+        tr = Trainer(pairs)
+        restore_inference_state(tr, model_path)
+        return cls(tr, **kw)
+
+    # -- shape plumbing --------------------------------------------------
+    def _to_input(self, data: np.ndarray) -> np.ndarray:
+        """Accept (n, features) flat, or 4-D in the engine's layout
+        (NCHW default, matching wrapper.Net) — returns NHWC float32.
+        Layout conversion itself is wrapper._to_nhwc (one definition of
+        the convention); the engine adds what only it can check: flat
+        row width against the model's input_shape, and the reshape of
+        flat rows onto a non-flat (c,y,x) input."""
+        from ..wrapper import _to_nhwc
+        data = np.asarray(data, np.float32)
+        c, y, x = self.input_shape
+        if data.ndim == 2:
+            if data.shape[1] != c * y * x:
+                raise ValueError(
+                    f"flat request row width {data.shape[1]} != model "
+                    f"input {c}*{y}*{x}")
+            if not (c == 1 and y == 1):
+                # flat rows in NCHW element order onto an image input
+                return _to_nhwc(data.reshape(-1, c, y, x), "NCHW")
+        return np.ascontiguousarray(_to_nhwc(data, self.layout))
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket >= n (largest bucket for oversize chunks)."""
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]
+
+    def _pad(self, rows: np.ndarray, bucket: int) -> np.ndarray:
+        if rows.shape[0] == bucket:
+            return rows
+        pad = np.zeros((bucket - rows.shape[0],) + rows.shape[1:],
+                       rows.dtype)
+        return np.concatenate([rows, pad], axis=0)
+
+    # -- compile cache ---------------------------------------------------
+    def _compiled(self, bucket: int, kind: str, node: Optional[str]):
+        """LRU lookup of the jitted executable for one (bucket, kind[,
+        node]) cell; a miss builds (and counts) a fresh jit closure —
+        the compile itself lands on the first call, i.e. inside the
+        miss request."""
+        key = (bucket, kind, node)
+        with self._lock:
+            fn = self._cache.get(key)
+            if fn is not None:
+                self._cache.move_to_end(key)
+                self.stats.record_cache(hit=True, size=len(self._cache))
+                return fn
+            evicted = False
+            while len(self._cache) >= self._cache_cap:
+                self._cache.popitem(last=False)
+                evicted = True
+            fn = self._build(kind, node)
+            self._cache[key] = fn
+            self.stats.record_cache(hit=False, size=len(self._cache),
+                                    evicted=evicted)
+            return fn
+
+    def _build(self, kind: str, node: Optional[str]):
+        import jax
+        import jax.numpy as jnp
+        net = self.trainer.net
+
+        if kind == "extract":
+            def fn(params, state, data):
+                res = net.apply(params, state, data, train=False,
+                                capture_nodes=True)
+                v = res.out if node in ("top", "top[-1]") \
+                    else res.nodes[node]
+                return v.reshape(v.shape[0], -1)
+        elif kind == "raw":
+            def fn(params, state, data):
+                res = net.apply(params, state, data, train=False)
+                return res.out.reshape(res.out.shape[0], -1)
+        else:                                   # "predict"
+            def fn(params, state, data):
+                res = net.apply(params, state, data, train=False)
+                out = res.out.reshape(res.out.shape[0], -1)
+                if out.shape[1] == 1:
+                    return out[:, 0]
+                return jnp.argmax(out, axis=1).astype(jnp.float32)
+        return jax.jit(fn)
+
+    # -- inference -------------------------------------------------------
+    def run_padded(self, rows_nhwc: np.ndarray, kind: str,
+                   node: Optional[str] = None) -> np.ndarray:
+        """One device call on pre-shaped NHWC rows: pad to the bucket,
+        run the cached executable, slice the real rows back out. This is
+        the batcher's dispatch entry — it must stay a SINGLE device call
+        per invocation."""
+        if kind not in _KINDS:
+            raise ValueError(f"unknown output kind {kind!r}")
+        n = rows_nhwc.shape[0]
+        bucket = self.bucket_for(n)
+        if n > bucket:
+            # never truncate silently: a short result would corrupt the
+            # batcher's per-request scatter offsets
+            raise ValueError(
+                f"run_padded: {n} rows exceed the largest bucket "
+                f"{bucket}; chunk to max_batch first")
+        tr = self.trainer
+        fn = self._compiled(bucket, kind, node)
+        padded = self._pad(rows_nhwc, bucket)
+        data = tr.mesh.shard_batch(padded)
+        out = np.asarray(fn(tr.params, tr.net_state, data))
+        return out[:n]
+
+    def _run(self, data, kind: str, node: Optional[str] = None
+             ) -> np.ndarray:
+        rows = self._to_input(data)
+        outs = []
+        off = 0
+        while off < rows.shape[0]:       # oversize: chunk by max bucket
+            chunk = rows[off:off + self.max_batch]
+            outs.append(self.run_padded(chunk, kind, node))
+            off += chunk.shape[0]
+        return outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)
+
+    def predict(self, data) -> np.ndarray:
+        """Class predictions (argmax; raw scalar for 1-col outputs) —
+        ``task = pred``."""
+        return self._run(data, "predict")
+
+    def predict_raw(self, data) -> np.ndarray:
+        """Full top-node rows (e.g. softmax probabilities) —
+        ``task = pred_raw``."""
+        return self._run(data, "raw")
+
+    def extract(self, data, node_name: str) -> np.ndarray:
+        """Named node activations ('top' = final node) —
+        ``task = extract_feature``."""
+        return self._run(data, "extract", node_name)
+
+    # -- introspection ---------------------------------------------------
+    def node_shape(self, node_name: str = "top") -> Tuple[int, int, int]:
+        return self.trainer.node_shape(node_name)
+
+    def cache_info(self) -> Dict[str, int]:
+        with self._lock:
+            return {"size": len(self._cache), "capacity": self._cache_cap,
+                    "hits": self.stats.cache_hits,
+                    "misses": self.stats.cache_misses,
+                    "evictions": self.stats.cache_evictions}

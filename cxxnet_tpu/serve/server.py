@@ -1,0 +1,189 @@
+"""Stdlib JSON front-end for the inference engine.
+
+``http.server``-based so the engine is drivable end-to-end with zero new
+dependencies (the same reason the IO pipeline is pure stdlib threading):
+
+* ``POST /predict``  ``{"data": [[...], ...], "raw": 0|1,
+  "timeout_ms": N?}`` -> ``{"pred": [...]}`` / ``{"prob": [[...]]}``
+* ``POST /extract``  ``{"data": ..., "node": "name"}``
+  -> ``{"features": [[...]]}``
+* ``GET  /healthz``  -> ``{"ok": true}``
+* ``GET  /statz``    -> the ServingStats snapshot dict
+
+Error mapping: malformed request 400, backpressure 503 (retry later),
+deadline exceeded 504, engine failure 500. Shutdown is graceful: stop
+accepting, then drain the batcher so queued requests still get answers.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from .batcher import Backpressure, DeadlineExceeded, MicroBatcher
+from .engine import InferenceEngine
+from .stats import ServingStats
+
+
+def _make_handler(server: "ServeServer"):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):          # quiet per-request spam
+            if not server.silent and server.verbose:
+                BaseHTTPRequestHandler.log_message(self, fmt, *args)
+
+        def _reply(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            if code >= 400:
+                # error paths may leave the POST body unread; on an
+                # HTTP/1.1 keep-alive socket those bytes would be parsed
+                # as the next request line — drop the connection instead
+                self.close_connection = True
+                self.send_header("Connection", "close")
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                ok = server.batcher is not None \
+                    and server.batcher._thread.is_alive()
+                self._reply(200 if ok else 500, {"ok": bool(ok)})
+            elif self.path == "/statz":
+                self._reply(200, server.stats.snapshot())
+            else:
+                self._reply(404, {"error": f"no such path {self.path}"})
+
+        def _read_json(self) -> dict:
+            n = int(self.headers.get("Content-Length", 0))
+            if n <= 0 or n > server.max_body_bytes:
+                raise ValueError(f"bad Content-Length {n}")
+            return json.loads(self.rfile.read(n).decode("utf-8"))
+
+        def do_POST(self):
+            if self.path not in ("/predict", "/extract"):
+                self._reply(404, {"error": f"no such path {self.path}"})
+                return
+            try:
+                req = self._read_json()
+                data = np.asarray(req["data"], np.float32)
+                if data.ndim == 1:       # single instance shorthand
+                    data = data[None, :]
+                timeout_ms = req.get("timeout_ms")
+                # hard cap so a wedged worker can't hang handler threads
+                # forever (batcher deadlines are the soft mechanism)
+                if self.path == "/extract":
+                    node = req.get("node", "top")
+                    fut = server.batcher.submit(data, "extract", node,
+                                                timeout_ms=timeout_ms)
+                    out = fut.result(timeout=server.result_timeout_s)
+                    self._reply(200, {"node": node,
+                                      "features": out.tolist()})
+                else:
+                    kind = "raw" if int(req.get("raw", 0)) else "predict"
+                    fut = server.batcher.submit(data, kind,
+                                                timeout_ms=timeout_ms)
+                    out = fut.result(timeout=server.result_timeout_s)
+                    key = "prob" if kind == "raw" else "pred"
+                    self._reply(200, {key: out.tolist()})
+            except Backpressure as e:
+                self._reply(503, {"error": str(e)})
+            except DeadlineExceeded as e:
+                self._reply(504, {"error": str(e)})
+            except (KeyError, ValueError, TypeError,
+                    json.JSONDecodeError) as e:
+                self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+            except Exception as e:
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+    return Handler
+
+
+class ServeServer:
+    """Engine + batcher + HTTP front-end, with a periodic stats log line
+    (the serving analog of the trainer's round metric line)."""
+
+    def __init__(self, engine: InferenceEngine,
+                 port: int = 0, host: str = "127.0.0.1",
+                 max_batch: Optional[int] = None,
+                 max_latency_ms: float = 5.0,
+                 max_queue_rows: int = 1024,
+                 default_timeout_ms: Optional[float] = None,
+                 log_interval_s: float = 30.0,
+                 silent: bool = False, verbose: bool = False,
+                 max_body_bytes: int = 64 << 20,
+                 result_timeout_s: float = 120.0):
+        self.engine = engine
+        self.stats: ServingStats = engine.stats
+        self.silent = silent
+        self.verbose = verbose
+        self.max_body_bytes = max_body_bytes
+        self.result_timeout_s = result_timeout_s
+        self.log_interval_s = log_interval_s
+        self.batcher = MicroBatcher(
+            engine, max_batch=max_batch, max_latency_ms=max_latency_ms,
+            max_queue_rows=max_queue_rows,
+            default_timeout_ms=default_timeout_ms, stats=self.stats)
+        self.httpd = ThreadingHTTPServer((host, port), _make_handler(self))
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self._http_thread: Optional[threading.Thread] = None
+        self._log_stop = threading.Event()
+        self._log_thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "ServeServer":
+        self._http_thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True,
+            name="serve-http")
+        self._http_thread.start()
+        if self.log_interval_s > 0 and not self.silent:
+            self._log_thread = threading.Thread(
+                target=self._log_loop, daemon=True, name="serve-statlog")
+            self._log_thread.start()
+        if not self.silent:
+            print(f"serving on http://{self.httpd.server_address[0]}:"
+                  f"{self.port} (/predict /extract /healthz /statz)",
+                  flush=True)
+        return self
+
+    def _log_loop(self) -> None:
+        while not self._log_stop.wait(self.log_interval_s):
+            print(self.stats.log_line(), flush=True)
+
+    def stop(self) -> None:
+        """Graceful: stop accepting, drain the batcher, then report."""
+        self._log_stop.set()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._http_thread is not None:
+            self._http_thread.join(timeout=10)
+        self.batcher.close(drain=True)
+        if not self.silent:
+            print(self.stats.log_line(), flush=True)
+
+    def serve_until_interrupt(self) -> None:
+        """Foreground loop for ``task = serve``: block until SIGINT/
+        SIGTERM, then shut down gracefully."""
+        import signal
+        stop = threading.Event()
+
+        def _sig(_signum, _frame):
+            stop.set()
+        prev_int = signal.signal(signal.SIGINT, _sig)
+        prev_term = signal.signal(signal.SIGTERM, _sig)
+        try:
+            while not stop.wait(0.2):
+                pass
+        finally:
+            signal.signal(signal.SIGINT, prev_int)
+            signal.signal(signal.SIGTERM, prev_term)
+            self.stop()

@@ -1,0 +1,186 @@
+"""Serving observability: QPS, latency percentiles, batch fill, cache hits.
+
+The counters are the serving analog of the trainer's per-round metric line
+(trainer.py round metrics): everything lands in one dict snapshot
+(``/statz``) and one periodic one-line log. All methods are thread-safe —
+the batcher worker, HTTP handler threads, and the engine all write here.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+
+class ServingStats:
+    """Rolling serving metrics.
+
+    * latency: bounded sample reservoir (last ``max_samples`` request
+      latencies) -> p50/p95/p99 at snapshot time;
+    * QPS: completion timestamps within a rolling ``qps_window_s`` window;
+    * batch fill: real rows / padded bucket rows, per dispatch;
+    * coalescing: requests folded into each device call;
+    * compile cache: hit/miss/evict counters fed by the engine.
+    """
+
+    def __init__(self, max_samples: int = 4096, qps_window_s: float = 60.0):
+        self._lock = threading.Lock()
+        self._t0 = time.time()
+        self.qps_window_s = qps_window_s
+        self._lat: deque = deque(maxlen=max_samples)       # seconds
+        self._done_ts: deque = deque(maxlen=65536)         # completion times
+        # request counters
+        self.requests_total = 0
+        self.requests_ok = 0
+        self.rejected_backpressure = 0
+        self.rejected_deadline = 0
+        self.failed = 0
+        # batch counters
+        self.batches_dispatched = 0
+        self.rows_real = 0
+        self.rows_padded = 0          # bucket rows incl. padding
+        self.requests_batched = 0     # requests folded into dispatches
+        self.batches_coalesced_ge2 = 0
+        # compile cache counters
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.cache_evictions = 0
+        self.cache_size = 0
+        self.cache_capacity = 0
+
+    # -- recording -------------------------------------------------------
+    def record_request(self) -> None:
+        with self._lock:
+            self.requests_total += 1
+
+    def record_reject(self, kind: str) -> None:
+        with self._lock:
+            if kind == "backpressure":
+                self.rejected_backpressure += 1
+            else:
+                self.rejected_deadline += 1
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self.failed += 1
+
+    def record_done(self, latency_s: float) -> None:
+        now = time.time()
+        with self._lock:
+            self.requests_ok += 1
+            self._lat.append(latency_s)
+            self._done_ts.append(now)
+
+    def record_batch(self, n_requests: int, rows_real: int,
+                     rows_bucket: int) -> None:
+        with self._lock:
+            self.batches_dispatched += 1
+            self.requests_batched += n_requests
+            self.rows_real += rows_real
+            self.rows_padded += rows_bucket
+            if n_requests >= 2:
+                self.batches_coalesced_ge2 += 1
+
+    def record_cache(self, hit: Optional[bool] = None,
+                     size: Optional[int] = None,
+                     capacity: Optional[int] = None,
+                     evicted: bool = False) -> None:
+        """``hit=None`` updates geometry only (no hit/miss tick)."""
+        with self._lock:
+            if hit is True:
+                self.cache_hits += 1
+            elif hit is False:
+                self.cache_misses += 1
+            if evicted:
+                self.cache_evictions += 1
+            if size is not None:
+                self.cache_size = size
+            if capacity is not None:
+                self.cache_capacity = capacity
+
+    # -- reading ---------------------------------------------------------
+    @staticmethod
+    def _pct(sorted_vals: List[float], q: float) -> float:
+        if not sorted_vals:
+            return 0.0
+        idx = min(len(sorted_vals) - 1,
+                  max(0, int(round(q * (len(sorted_vals) - 1)))))
+        return sorted_vals[idx]
+
+    def snapshot(self) -> Dict:
+        """One coherent dict of everything — the ``/statz`` payload.
+        Only cheap copies happen under the lock; the deque scan and the
+        percentile sort run outside it so a monitoring poller never
+        stalls the dispatch hot path's record_* calls."""
+        with self._lock:
+            now = time.time()
+            lat_raw = list(self._lat)
+            done_ts = list(self._done_ts)
+            counters = (self.requests_total, self.requests_ok,
+                        self.rejected_backpressure, self.rejected_deadline,
+                        self.failed, self.batches_dispatched,
+                        self.requests_batched, self.rows_real,
+                        self.rows_padded, self.batches_coalesced_ge2,
+                        self.cache_hits, self.cache_misses,
+                        self.cache_evictions, self.cache_size,
+                        self.cache_capacity)
+        (req_total, req_ok, rej_bp, rej_dl, failed, b_disp, req_batched,
+         rows_real, rows_padded, coalesced, c_hit, c_miss, c_evict,
+         c_size, c_cap) = counters
+        uptime = max(now - self._t0, 1e-9)
+        window = min(self.qps_window_s, uptime)
+        cutoff = now - window
+        recent = sum(1 for t in done_ts if t >= cutoff)
+        lat = sorted(lat_raw)
+        fill = rows_real / rows_padded if rows_padded else 0.0
+        rpb = req_batched / b_disp if b_disp else 0.0
+        return {
+            "uptime_s": round(uptime, 3),
+            "requests": {
+                "total": req_total,
+                "ok": req_ok,
+                "rejected_backpressure": rej_bp,
+                "rejected_deadline": rej_dl,
+                "failed": failed,
+            },
+            "qps": round(recent / window, 3) if window else 0.0,
+            "latency_ms": {
+                "p50": round(1e3 * self._pct(lat, 0.50), 3),
+                "p95": round(1e3 * self._pct(lat, 0.95), 3),
+                "p99": round(1e3 * self._pct(lat, 0.99), 3),
+                "mean": round(1e3 * sum(lat) / len(lat), 3)
+                        if lat else 0.0,
+                "samples": len(lat),
+            },
+            "batches": {
+                "dispatched": b_disp,
+                "coalesced_ge2": coalesced,
+                "avg_requests_per_batch": round(rpb, 3),
+                "fill_ratio": round(fill, 4),
+                "rows_real": rows_real,
+                "rows_padded": rows_padded,
+            },
+            "compile_cache": {
+                "hits": c_hit,
+                "misses": c_miss,
+                "evictions": c_evict,
+                "size": c_size,
+                "capacity": c_cap,
+            },
+        }
+
+    def log_line(self) -> str:
+        """One-line periodic log, same spirit as the trainer's round line:
+        ``serve[   12 sec]\tqps:3.2\tp50_ms:1.4 ...``"""
+        s = self.snapshot()
+        return ("serve[%5d sec]\tqps:%.2f\tp50_ms:%.2f\tp95_ms:%.2f"
+                "\tp99_ms:%.2f\tfill:%.3f\tcache_hit:%d\tcache_miss:%d"
+                "\tok:%d\trej:%d" % (
+                    s["uptime_s"], s["qps"], s["latency_ms"]["p50"],
+                    s["latency_ms"]["p95"], s["latency_ms"]["p99"],
+                    s["batches"]["fill_ratio"], s["compile_cache"]["hits"],
+                    s["compile_cache"]["misses"], s["requests"]["ok"],
+                    s["requests"]["rejected_backpressure"]
+                    + s["requests"]["rejected_deadline"]))

@@ -31,7 +31,7 @@ from .config import ConfigPairs, parse_config_string
 from .io.data import DataBatch, create_iterator
 from .trainer import Trainer
 
-__all__ = ["DataIter", "Net", "train"]
+__all__ = ["DataIter", "Net", "train", "create_engine", "engine_predict"]
 
 
 def _to_nhwc(data: np.ndarray, layout: str) -> np.ndarray:
@@ -232,6 +232,35 @@ class Net:
     def trainer(self) -> Trainer:
         """Escape hatch to the full Trainer API."""
         return self._require()
+
+    # -- serving ------------------------------------------------------------
+    def create_engine(self, **kw):
+        """Wrap this net's trained params into a serve.InferenceEngine
+        (bucketed compile cache + predict/predict_raw/extract) — the
+        online-serving capability the C API never had. Keyword args pass
+        through (buckets, max_batch, cache_size, stats)."""
+        from .serve.engine import InferenceEngine
+        kw.setdefault("layout", self._layout)
+        return InferenceEngine(self._require(), **kw)
+
+
+def create_engine(cfg: Union[str, ConfigPairs], model_path: str,
+                  dev: str = "", layout: str = "NCHW", **kw):
+    """One-call engine construction from a net config + checkpoint:
+    optimizer state is stripped before device placement
+    (checkpoint.load_for_inference)."""
+    from .serve.engine import InferenceEngine
+    pairs = parse_config_string(cfg) if isinstance(cfg, str) else list(cfg)
+    if dev:
+        pairs = pairs + [("dev", dev)]
+    return InferenceEngine.from_checkpoint(pairs, model_path,
+                                           layout=layout, **kw)
+
+
+def engine_predict(engine, data, raw: bool = False) -> np.ndarray:
+    """Engine prediction on raw arrays (NCHW 4-D or flat 2-D, like
+    Net.predict): argmax classes, or full top-node rows with raw=True."""
+    return engine.predict_raw(data) if raw else engine.predict(data)
 
 
 def train(cfg: Union[str, ConfigPairs], data, label=None, num_round: int = 1,

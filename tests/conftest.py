@@ -16,7 +16,19 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    # newer JAX: works even after import, before backend init
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older JAX (e.g. 0.4.x) has no such option. XLA_FLAGS is read at
+    # BACKEND initialization (the first devices() query), not at module
+    # import, so the env route still works here even though jax itself
+    # was imported at interpreter startup — as long as nothing has
+    # initialized the backend yet.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
 
 import sys
 

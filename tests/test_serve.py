@@ -1,0 +1,306 @@
+"""Serving subsystem tests: bucket/padding correctness, micro-batcher
+flush triggers, deadline/backpressure rejection, compile-cache
+accounting, and the inference-only checkpoint load."""
+
+import os
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.config import parse_config_string
+from cxxnet_tpu.io.data import create_iterator
+from cxxnet_tpu.trainer import Trainer
+from cxxnet_tpu.serve import (Backpressure, DeadlineExceeded,
+                              InferenceEngine, MicroBatcher, ServingStats)
+
+NET_CFG = """
+netconfig=start
+layer[+1:h1] = fullc:fc1
+  nhidden = 24
+  random_type = xavier
+layer[+1:a1] = relu
+layer[a1->out] = fullc:fc2
+  nhidden = 5
+  random_type = xavier
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,16
+batch_size = 32
+eta = 0.3
+metric = error
+"""
+
+SYN_ITER = """
+iter = synthetic
+num_inst = 256
+batch_size = 32
+num_class = 5
+input_shape = 1,1,16
+seed_data = 3
+"""
+
+
+def make_engine(mesh, **kw):
+    tr = Trainer(parse_config_string(NET_CFG), mesh_ctx=mesh)
+    tr.init_model()
+    kw.setdefault("buckets", "2,4,8,16")
+    kw.setdefault("max_batch", 16)
+    return InferenceEngine(tr, **kw)
+
+
+@pytest.fixture()
+def engine(mesh1):
+    return make_engine(mesh1)
+
+
+def rows(n, seed=0):
+    return np.random.RandomState(seed).randn(n, 16).astype(np.float32)
+
+
+# -- bucket selection / padding correctness -------------------------------
+
+def test_bucket_selection(engine):
+    assert engine.bucket_for(1) == 2
+    assert engine.bucket_for(2) == 2
+    assert engine.bucket_for(3) == 4
+    assert engine.bucket_for(9) == 16
+    assert engine.bucket_for(99) == 16    # oversize -> chunk by largest
+
+
+def test_padded_rows_match_unpadded(engine):
+    """Zero-padding up to the bucket must not perturb the real rows:
+    5 rows (padded to bucket 8) == the same 5 rows inside a full
+    8-row request."""
+    x = rows(8)
+    r_pad = engine.predict_raw(x[:5])          # bucket 8, 3 pad rows
+    r_full = engine.predict_raw(x)             # bucket 8, no padding
+    np.testing.assert_allclose(r_pad, r_full[:5], atol=1e-6)
+    p_pad = engine.predict(x[:5])
+    np.testing.assert_array_equal(p_pad, engine.predict(x)[:5])
+
+
+def test_oversize_request_chunks(engine):
+    x = rows(37)                               # > max bucket 16
+    out = engine.predict_raw(x)
+    assert out.shape == (37, 5)
+    np.testing.assert_allclose(out[:8], engine.predict_raw(x[:8]),
+                               atol=1e-6)
+
+
+def test_extract_matches_trainer(engine):
+    from cxxnet_tpu.io.data import DataBatch
+    x = rows(4)
+    feats = engine.extract(x, "a1")
+    batch = DataBatch(data=x.reshape(4, 1, 1, 16),
+                      label=np.zeros((4, 1), np.float32))
+    ref = engine.trainer.extract_feature(batch, "a1")
+    np.testing.assert_allclose(feats, ref, atol=1e-6)
+
+
+def test_bucket_divisibility_validated(mesh8):
+    tr = Trainer(parse_config_string(NET_CFG), mesh_ctx=mesh8)
+    tr.init_model()
+    with pytest.raises(ValueError, match="not divisible"):
+        InferenceEngine(tr, buckets="2,4", max_batch=4)
+    # dp-aligned buckets work on the 8-device mesh
+    eng = InferenceEngine(tr, buckets="8,16", max_batch=16)
+    assert eng.predict_raw(rows(3)).shape == (3, 5)
+
+
+# -- compile-cache accounting ---------------------------------------------
+
+def test_explicit_buckets_honor_max_batch(mesh1):
+    # an explicit ladder topping out below max_batch gains max_batch as
+    # its top bucket — serve_max_batch stays authoritative and the HTTP
+    # path accepts the request sizes the operator configured
+    eng = make_engine(mesh1, buckets="2,4", max_batch=16)
+    assert eng.buckets == [2, 4, 16]
+    assert eng.max_batch == 16
+    b = MicroBatcher(eng, max_batch=16, max_latency_ms=10)
+    out = b.submit(rows(8)).result(timeout=10)
+    b.close()
+    assert out.shape == (8,)
+
+
+def test_bucket_above_max_batch_rejected(mesh1):
+    # max_batch is the operator's per-dispatch cap; a larger explicit
+    # bucket must be a config error, not a silent cap raise
+    with pytest.raises(ValueError, match="exceeds max_batch"):
+        make_engine(mesh1, buckets="2,4,32", max_batch=16)
+
+
+def test_cache_size_validated(mesh1):
+    with pytest.raises(ValueError, match="cache_size"):
+        make_engine(mesh1, cache_size=0)
+
+
+def test_cache_hit_miss_accounting(engine):
+    s = engine.stats
+    engine.predict_raw(rows(3))                # miss: raw@4
+    engine.predict_raw(rows(4, seed=1))        # hit: same bucket
+    engine.predict_raw(rows(7))                # miss: raw@8
+    engine.predict(rows(3))                    # miss: predict@4 (new kind)
+    engine.predict(rows(2))                    # miss: predict@2
+    engine.predict(rows(1))                    # hit: predict@2
+    assert s.cache_misses == 4
+    assert s.cache_hits == 2
+
+
+def test_cache_lru_eviction(mesh1):
+    eng = make_engine(mesh1, cache_size=2)
+    eng.predict_raw(rows(2))                   # raw@2
+    eng.predict_raw(rows(4))                   # raw@4
+    eng.predict_raw(rows(8))                   # raw@8 -> evicts raw@2
+    assert eng.stats.cache_evictions >= 1
+    assert eng.cache_info()["size"] == 2
+    eng.predict_raw(rows(2))                   # re-miss after eviction
+    assert eng.stats.cache_misses == 4
+
+
+# -- micro-batcher --------------------------------------------------------
+
+def test_batcher_flushes_on_max_batch(engine):
+    b = MicroBatcher(engine, max_batch=8, max_latency_ms=10_000)
+    t0 = time.perf_counter()
+    futs = [b.submit(rows(2, seed=i)) for i in range(4)]   # 8 rows total
+    outs = [f.result(timeout=10) for f in futs]
+    took = time.perf_counter() - t0
+    b.close()
+    assert took < 5.0, "flush must come from max_batch, not max_latency"
+    assert all(o.shape == (2,) for o in outs)
+    assert engine.stats.batches_dispatched >= 1
+    assert engine.stats.batches_coalesced_ge2 >= 1
+
+
+def test_batcher_flushes_on_latency(engine):
+    b = MicroBatcher(engine, max_batch=16, max_latency_ms=50)
+    fut = b.submit(rows(1))                    # far below max_batch
+    out = fut.result(timeout=10)
+    b.close()
+    assert out.shape == (1,)
+    assert engine.stats.batches_dispatched >= 1
+
+
+def test_batcher_matches_direct_engine(engine):
+    x = rows(6)
+    b = MicroBatcher(engine, max_batch=8, max_latency_ms=20)
+    futs = [b.submit(x[i:i + 2]) for i in range(0, 6, 2)]
+    got = np.concatenate([f.result(timeout=10) for f in futs])
+    b.close()
+    np.testing.assert_array_equal(got, engine.predict(x))
+
+
+def test_deadline_rejection_under_load(engine):
+    # the worker is stalled inside an earlier dispatch; by the time the
+    # stalled worker reaches this request its deadline has passed and it
+    # must be rejected, not served stale
+    real = engine.run_padded
+    engine.run_padded = lambda *a, **k: (time.sleep(0.3), real(*a, **k))[1]
+    b = MicroBatcher(engine, max_batch=16, max_latency_ms=1)
+    first = b.submit(rows(1))          # dispatches, stalls the worker
+    time.sleep(0.05)                   # let the worker pick it up
+    fut = b.submit(rows(1), timeout_ms=50)
+    with pytest.raises(DeadlineExceeded):
+        fut.result(timeout=10)
+    assert first.result(timeout=10).shape == (1,)
+    b.close()
+    engine.run_padded = real
+    assert engine.stats.rejected_deadline == 1
+
+
+def test_short_deadline_served_when_idle(engine):
+    # a timeout_ms shorter than the latency window must pull the flush
+    # forward, not guarantee rejection (the worker wakes at the earliest
+    # member deadline, not only at the window end)
+    b = MicroBatcher(engine, max_batch=16, max_latency_ms=10_000)
+    t0 = time.perf_counter()
+    # 500 ms: far below the 10 s window, but wide enough that worker
+    # wakeup jitter under a loaded CPU can't push dispatch past it
+    fut = b.submit(rows(1), timeout_ms=500)
+    out = fut.result(timeout=30)
+    took = time.perf_counter() - t0
+    b.close()
+    assert out.shape == (1,)
+    assert took < 5.0, "flush must come from the deadline, not the window"
+    assert engine.stats.rejected_deadline == 0
+
+
+def test_backpressure_rejection(engine):
+    # stall the device call so the queue saturates
+    real = engine.run_padded
+    engine.run_padded = lambda *a, **k: (time.sleep(0.4), real(*a, **k))[1]
+    b = MicroBatcher(engine, max_batch=2, max_latency_ms=1,
+                     max_queue_rows=4)
+    futs = [b.submit(rows(1, seed=i)) for i in range(4)]   # fills budget
+    with pytest.raises(Backpressure):
+        for i in range(20):                   # worker is stalled mid-batch
+            futs.append(b.submit(rows(1, seed=99 + i)))
+    assert engine.stats.rejected_backpressure >= 1
+    b.close(drain=True)
+    engine.run_padded = real
+    # everything accepted before the rejection still completes (drain)
+    done = [f for f in futs if f.done() and not f.exception()]
+    assert len(done) == len(futs)
+
+
+def test_batcher_close_drains(engine):
+    b = MicroBatcher(engine, max_batch=16, max_latency_ms=5_000)
+    futs = [b.submit(rows(1, seed=i)) for i in range(3)]
+    b.close(drain=True)                        # flush without the window
+    for f in futs:
+        assert f.result(timeout=1).shape == (1,)
+
+
+# -- stats ----------------------------------------------------------------
+
+def test_stats_snapshot_schema(engine):
+    b = MicroBatcher(engine, max_batch=4, max_latency_ms=10)
+    [f.result(timeout=10) for f in [b.submit(rows(2)), b.submit(rows(2))]]
+    b.close()
+    s = engine.stats.snapshot()
+    assert s["requests"]["ok"] == 2
+    assert 0 < s["batches"]["fill_ratio"] <= 1.0
+    lat = s["latency_ms"]
+    assert lat["p50"] > 0 and lat["p99"] >= lat["p50"]
+    assert s["compile_cache"]["misses"] >= 1
+    assert "serve[" in engine.stats.log_line()
+
+
+# -- inference-only checkpoint load ---------------------------------------
+
+def test_load_for_inference_strips_opt(mesh1, tmp_path):
+    from cxxnet_tpu import checkpoint as ckpt
+    tr = Trainer(parse_config_string(NET_CFG), mesh_ctx=mesh1)
+    tr.init_model()
+    for batch in create_iterator(parse_config_string(SYN_ITER)):
+        tr.update(batch)
+    path = os.path.join(str(tmp_path), "0000.model")
+    tr.save_model(path)
+    full = ckpt.load_model(path)
+    slim = ckpt.load_for_inference(path)
+    assert full["opt"] is not None
+    assert "opt" not in slim
+    assert set(slim["params"]) == set(full["params"])
+
+    eng = InferenceEngine.from_checkpoint(
+        parse_config_string(NET_CFG), path, buckets="8", max_batch=8)
+    assert eng.trainer.opt_state is None
+    x = rows(8)
+    from cxxnet_tpu.io.data import DataBatch
+    ref = tr.predict_raw(DataBatch(data=x.reshape(8, 1, 1, 16),
+                                   label=np.zeros((8, 1), np.float32)))
+    np.testing.assert_allclose(eng.predict_raw(x), ref, atol=1e-5)
+
+
+def test_wrapper_create_engine(mesh1):
+    from cxxnet_tpu import wrapper
+    net = wrapper.Net(cfg=NET_CFG)
+    net._trainer = Trainer(parse_config_string(NET_CFG), mesh_ctx=mesh1)
+    net._trainer.init_model()
+    eng = net.create_engine(buckets="4,8", max_batch=8)
+    x = rows(3, seed=5)
+    np.testing.assert_array_equal(wrapper.engine_predict(eng, x),
+                                  eng.predict(x))
+    assert wrapper.engine_predict(eng, x, raw=True).shape == (3, 5)

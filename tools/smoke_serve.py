@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Serving smoke check (tier-1-adjacent; CPU-safe).
+
+Trains one tiny round, wraps the checkpoint into an InferenceEngine,
+starts the HTTP server on an ephemeral port, and drives it end-to-end:
+
+  1. /healthz answers ok;
+  2. /predict answers for two different request sizes with ONE compile
+     per distinct shape bucket (cache-miss counter == #buckets);
+  3. a second burst of mixed-size requests completes with ZERO new
+     compiles, and the batcher coalesced >= 2 concurrent requests into
+     a single device call at least once (from the /statz snapshot);
+  4. /statz reports latency percentiles and a batch-fill ratio.
+
+Exits nonzero on any failure.  Run:  JAX_PLATFORMS=cpu python tools/smoke_serve.py
+"""
+
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+NET_CFG = """
+netconfig=start
+layer[+1:h1] = fullc:fc1
+  nhidden = 32
+  random_type = xavier
+layer[+1:a1] = relu
+layer[a1->out] = fullc:fc2
+  nhidden = 5
+  random_type = xavier
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,16
+batch_size = 64
+eta = 0.3
+dev = cpu
+eval_train = 0
+"""
+
+SYN_ITER = """
+iter = synthetic
+num_inst = 512
+batch_size = 64
+num_class = 5
+input_shape = 1,1,16
+seed_data = 3
+"""
+
+
+def http_json(port, path, payload=None, timeout=30):
+    url = f"http://127.0.0.1:{port}{path}"
+    if payload is None:
+        req = urllib.request.Request(url)
+    else:
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode("utf-8"),
+            headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read().decode("utf-8"))
+
+
+def main() -> int:
+    import numpy as np
+    from cxxnet_tpu.config import parse_config_string
+    from cxxnet_tpu.io.data import create_iterator
+    from cxxnet_tpu.trainer import Trainer
+    from cxxnet_tpu.serve.server import ServeServer
+    from cxxnet_tpu import wrapper
+
+    # 1 tiny training round -> checkpoint
+    tr = Trainer(parse_config_string(NET_CFG))
+    tr.init_model()
+    for batch in create_iterator(parse_config_string(SYN_ITER)):
+        tr.update(batch)
+    with tempfile.TemporaryDirectory() as td:
+        model = os.path.join(td, "0000.model")
+        tr.save_model(model)
+
+        # engine from the checkpoint (load_for_inference path: no opt state)
+        engine = wrapper.create_engine(NET_CFG, model,
+                                       buckets="2,4,8", max_batch=8)
+        srv = ServeServer(engine, port=0, max_latency_ms=30,
+                          log_interval_s=0, silent=True).start()
+        port = srv.port
+        try:
+            hz = http_json(port, "/healthz")
+            assert hz.get("ok") is True, f"/healthz not ok: {hz}"
+
+            rng = np.random.RandomState(0)
+            # burst 1: three request sizes -> three distinct buckets
+            # (1->2, 3->4, 7->8)
+            r1 = http_json(port, "/predict",
+                           {"data": rng.randn(1, 16).tolist()})
+            assert len(r1["pred"]) == 1, f"bad /predict shape: {r1}"
+            r3 = http_json(port, "/predict",
+                           {"data": rng.randn(3, 16).tolist()})
+            assert len(r3["pred"]) == 3, f"bad /predict shape: {r3}"
+            r7 = http_json(port, "/predict",
+                           {"data": rng.randn(7, 16).tolist()})
+            assert len(r7["pred"]) == 7, f"bad /predict shape: {r7}"
+            raw = http_json(port, "/predict",
+                            {"data": rng.randn(2, 16).tolist(), "raw": 1})
+            assert len(raw["prob"]) == 2 and len(raw["prob"][0]) == 5, \
+                f"bad raw shape: {raw}"
+            feat = http_json(port, "/extract",
+                             {"data": rng.randn(2, 16).tolist(),
+                              "node": "a1"})
+            assert len(feat["features"][0]) == 32, f"bad extract: {feat}"
+
+            s1 = http_json(port, "/statz")
+            # cells exercised: predict@{2,4,8}, raw@2, extract@2 —
+            # exactly one compile per distinct (bucket, kind) cell
+            misses1 = s1["compile_cache"]["misses"]
+            assert misses1 == 5, \
+                f"expected 5 compiles (one per bucket+kind), got {misses1}"
+
+            # burst 2: concurrent mixed sizes — zero recompiles, and the
+            # batcher must coalesce >= 2 requests into one device call
+            def fire(n):
+                return http_json(port, "/predict",
+                                 {"data": rng.randn(n, 16).tolist()})
+            with ThreadPoolExecutor(8) as ex:
+                outs = list(ex.map(fire, [1, 2, 3, 1, 2, 3, 1, 2]))
+            for n, o in zip([1, 2, 3, 1, 2, 3, 1, 2], outs):
+                assert len(o["pred"]) == n, f"burst-2 shape: {n} vs {o}"
+
+            s2 = http_json(port, "/statz")
+            misses2 = s2["compile_cache"]["misses"]
+            assert misses2 == misses1, \
+                f"second burst recompiled: {misses1} -> {misses2}"
+            assert s2["batches"]["coalesced_ge2"] >= 1, \
+                f"batcher never coalesced: {s2['batches']}"
+            lat = s2["latency_ms"]
+            assert lat["p50"] > 0 and lat["p95"] >= lat["p50"] \
+                and lat["p99"] >= lat["p95"], f"bad percentiles: {lat}"
+            assert 0 < s2["batches"]["fill_ratio"] <= 1.0, \
+                f"bad fill ratio: {s2['batches']}"
+            print("smoke_serve OK:",
+                  json.dumps({"misses": misses2,
+                              "coalesced_ge2":
+                                  s2["batches"]["coalesced_ge2"],
+                              "fill": s2["batches"]["fill_ratio"],
+                              "p50_ms": lat["p50"],
+                              "p99_ms": lat["p99"]}))
+        finally:
+            srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
