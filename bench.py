@@ -3,9 +3,18 @@
 
 Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N,
-     "model_tflops": ..., "mfu_pct": ..., "roofline_pct": ...,
-     "arith_intensity": ..., "e2e_images_per_sec_per_chip": ...,
-     "loss_start": ..., "loss_end": ...}
+     "model_tflops": ..., "mfu_pct": ..., "mfu_est": ...,
+     "achieved_flops": ..., "compute_dtype": "bfloat16", "roofline_pct":
+     ..., "arith_intensity": ..., "e2e_images_per_sec_per_chip": ...,
+     "fp32_compare": {...,"speedup_vs_f32": N}, "loss_start": ...,
+     "loss_end": ...}
+
+Every phase (flagship compute, e2e, secondary models, the fp32 rerun)
+reports achieved FLOP/s + an MFU estimate and is tagged with its compute
+dtype, so the bf16-vs-fp32 speedup lands in the metric trajectory as a
+measured ratio (``fp32_compare.speedup_vs_f32``), not an anecdote. FLOPs
+come from the compiled executable's cost analysis, falling back to an
+analytic conv/matmul count on backends that report none.
 
 Three claims, each verified in-run:
   * throughput  — images/sec/chip of the real train step (forward +
@@ -72,16 +81,61 @@ def chip_peaks(device):
     return 0.0, 0.0   # unknown (e.g. CPU smoke run) -> mfu reported as 0
 
 
-def make_trainer(scale, image, classes, batch, platform):
+def make_trainer(scale, image, classes, batch, platform, overrides=()):
     from cxxnet_tpu.config import parse_config_string
     from cxxnet_tpu.trainer import Trainer
     from gen_inception_bn import generate
     txt = generate(scale=scale, image_size=image, num_class=classes,
                    batch_size=batch, with_data=False)
-    cfg = parse_config_string(txt) + [("eval_train", "0"), ("dev", platform)]
+    cfg = parse_config_string(txt) + [("eval_train", "0"),
+                                      ("dev", platform)] + list(overrides)
     tr = Trainer(cfg)
     tr.init_model()
     return tr
+
+
+def dtype_name(tr) -> str:
+    """The trainer's compute dtype as a JSON-friendly tag ('float32' /
+    'bfloat16' / 'float16') — every emitted metric carries it so a
+    bf16-vs-f32 speedup reads out of the metric trajectory as a ratio
+    of like-tagged numbers, not an anecdote."""
+    return tr.policy.compute_name
+
+
+def analytic_step_flops(tr, batch) -> float:
+    """Analytic conv/matmul FLOP count for ONE train step — the fallback
+    when the backend's compiled cost_analysis reports no 'flops' key
+    (observed on some CPU/plugin backends). Forward matmul/conv work is
+    2*M*N*K; the backward pass recomputes ~2x that (dX and dW), so the
+    train step is ~3x forward. MXU-dominant layers only (conv, fullc,
+    seqfc, ffn, mha) — elementwise/norm traffic is bandwidth, not FLOPs,
+    at the roofline scales this grounds."""
+    total = 0.0
+    g, net = tr.graph, tr.net
+    for li, (spec, layer) in enumerate(zip(g.layers, net.layers)):
+        t = (g.layers[spec.primary_layer_index].type if spec.is_shared
+             else spec.type)
+        in_sh = net._in_shapes_of[li]
+        out_sh = net.layer_out_shapes[li]
+        if t == "conv":
+            cout, oy, ox = out_sh[0]
+            hp = layer.hp
+            total += 2.0 * batch * oy * ox * hp.kernel_height * \
+                hp.kernel_width * (layer._cin // hp.num_group) * cout
+        elif t == "fullc":
+            total += 2.0 * batch * layer._in_num * layer.hp.num_hidden
+        elif t == "seqfc":
+            e, s, _ = in_sh[0]
+            total += 2.0 * batch * s * e * layer.hp.num_hidden
+        elif t == "ffn":
+            e, s, _ = in_sh[0]
+            f = layer.hp.num_hidden or 4 * e
+            total += 2.0 * 2.0 * batch * s * e * f
+        elif t == "mha":
+            e, s, _ = in_sh[0]
+            total += 4.0 * 2.0 * batch * s * e * e   # q/k/v/o projections
+            total += 2.0 * 2.0 * batch * s * s * e   # qk^T and pv
+    return 3.0 * total
 
 
 def make_conf_trainer(conf_rel, batch, platform, overrides=()):
@@ -145,6 +199,13 @@ def compute_bench(tr, image, classes, batch, steps, ref_cost_fn=None):
     b.label = tr.mesh.shard_batch(b.label)   # device-resident: time compute
 
     cost = tr.step_cost_analysis(b)          # compiles once (cache-shared)
+    # FLOPs ground truth: XLA's compiled cost analysis, falling back to
+    # the analytic conv/matmul count when the backend reports none — the
+    # MFU number must exist on every backend, CPU smoke runs included
+    flops_source = "cost_analysis"
+    if not cost.get("flops"):
+        cost = dict(cost, flops=analytic_step_flops(tr, batch))
+        flops_source = "analytic"
     # probe chain: estimate the per-step time, then size K2 for a ~1.5-3 s
     # timed chain so the K2-K1 difference dwarfs link jitter (+-tens of ms
     # observed). The FIRST probe call pays the scan's jit compile, which
@@ -275,12 +336,24 @@ def compute_bench(tr, image, classes, batch, steps, ref_cost_fn=None):
     achievable = min(peak, ai * hbm_gbs / 1e3) if peak and have_bytes else 0.0
     roofline_pct = (100.0 * sustained_tflops / achievable
                     if achievable else 0.0)
+    mfu = 100.0 * sustained_tflops / peak if peak else 0.0
     return {
         "ips": ips,
         "per_step_ms": dt_step * 1e3,
         "step_tflop": cost["flops"] / 1e12,
         "model_tflops": sustained_tflops,
-        "mfu_pct": 100.0 * sustained_tflops / peak if peak else 0.0,
+        # achieved FLOP/s per chip (raw, not TFLOP-scaled) and the MFU
+        # estimate against the chip's dense bf16 peak — the per-phase
+        # pair every bench section reports; mfu_est is 0 when the chip
+        # peak is unknown (CPU smoke runs)
+        "achieved_flops": flops / dt_step,
+        "mfu_est": mfu,
+        "flops_source": flops_source,
+        "compute_dtype": dtype_name(tr),
+        # mfu_pct: legacy alias of mfu_est for compute phases (kept so
+        # earlier trajectory entries keep comparing); the e2e phase is
+        # the one place mfu_est is a distinct (ips-derived) quantity
+        "mfu_pct": mfu,
         # >100 is possible and fine: cost_analysis bytes are pre-fusion
         # (every intermediate counted); when XLA fuses intermediates away
         # the true arithmetic intensity exceeds the estimate, so the
@@ -379,7 +452,13 @@ def e2e_bench(tr, image, classes, batch, steps, device_normalize=0,
             """Consume n_batches through the train path; wall time to a
             true value sync (block_until_ready on donation-aliased
             outputs returns early over the remote tunnel — only a value
-            fetch is a real barrier)."""
+            fetch is a real barrier). With chaining, only whole
+            dispatched chains are timed AND counted: a leftover partial
+            chain (iterator exhausted mid-chain) is dropped after the
+            sync instead of flushed through per-batch update() inside
+            the window — the first such flush would compile the
+            non-chain train step and skew that window's slope
+            (ADVICE r5)."""
             t0 = time.perf_counter()
             count, pend = 0, []
             # chain=0 keeps r04's device-side double buffering (H2D of
@@ -388,16 +467,18 @@ def e2e_bench(tr, image, classes, batch, steps, device_normalize=0,
             for b in src:
                 if chain:
                     pend.append(copy(b))
-                    if len(pend) == chain:
-                        tr.update_chain_batches(pend)
-                        pend = []
+                    if len(pend) < chain:
+                        continue
+                    rows = sum(x.batch_size - x.num_batch_padd
+                               for x in pend)
+                    tr.update_chain_batches(pend)
+                    pend = []
+                    count += rows
                 else:
                     tr.update(b)
-                count += b.batch_size - b.num_batch_padd
+                    count += b.batch_size - b.num_batch_padd
                 if count >= n_batches * batch:
                     break
-            for b in pend:
-                tr.update(b)
             float(tr.last_loss)
             return time.perf_counter() - t0, count
 
@@ -441,7 +522,12 @@ def e2e_bench(tr, image, classes, batch, steps, device_normalize=0,
         "dispatch": (f"update_chain_batches k={chain}" if chain
                      else "per-batch update (prefetch double-buffered)"),
         "timing": timing,
+        "compute_dtype": dtype_name(tr),
     }
+    if chain:
+        detail["tail"] = ("partial chains dropped outside the timed "
+                          "windows (a per-batch flush would compile the "
+                          "non-chain step mid-window)")
     if chain_fallback:
         detail["chain_fallback"] = True
     return ips_raw / n_chips, detail
@@ -618,6 +704,10 @@ def main() -> None:
         help="wall-clock budget in seconds (env BENCH_BUDGET_S); phases "
              "shrink/skip to fit and the final JSON line always lands")
     args = ap.parse_args()
+    # timed paths don't pay for diagnostics: keep the BN variance-clamp
+    # telemetry (min + cond + host callback per BN layer per step) out
+    # of every compiled step this bench measures
+    os.environ.setdefault("CXXNET_BN_CLAMP_WARN", "0")
     partial = {
         "metric": "inception_bn_train_images_per_sec_per_chip",
         "value": None, "unit": "images/sec/chip",
@@ -671,12 +761,52 @@ def main() -> None:
         "value": round(c["ips"], 2),
         "vs_baseline": round(c["ips"] / BASELINE_IPS, 3),
         "mfu_pct": round(c["mfu_pct"], 2),
+        "mfu_est": round(c["mfu_est"], 2),
+        "achieved_flops": round(c["achieved_flops"], 1),
+        "flops_source": c["flops_source"],
+        "compute_dtype": c["compute_dtype"],
         "per_step_ms": round(c["per_step_ms"], 3),
         "loss_start": round(c["loss_start"], 4),
         "loss_end": round(c["loss_end"], 4),
         "n_chips": c["n_chips"],
         "chip": jax.devices()[0].device_kind,
     })
+    # bf16-vs-fp32 as a measured RATIO in the same JSON line: the
+    # flagship conf computes in bf16 (gen_inception_bn emits
+    # compute_dtype = bfloat16), so one fp32-policy rerun of the same
+    # model prices the dtype lever directly. Short window (half steps) —
+    # the ratio needs less precision than the headline number.
+    # None only when the flagship already computes fp32 (no comparison
+    # applies); a budget skip leaves an explicit marker so the ratio's
+    # absence is distinguishable in the trajectory
+    fp32_cmp = None
+    if c["compute_dtype"] != "float32" and budget.low(120, "fp32_compare"):
+        fp32_cmp = {"skipped": "budget"}
+    elif c["compute_dtype"] != "float32":
+        try:
+            tr32 = make_trainer(scale, image, classes, batch, platform,
+                                overrides=(("compute_dtype", "float32"),))
+            c32 = compute_bench(tr32, image, classes, batch,
+                                max(3, steps // 2))
+            fp32_cmp = {
+                "images_per_sec_per_chip": round(c32["ips"], 2),
+                "per_step_ms": round(c32["per_step_ms"], 3),
+                "achieved_flops": round(c32["achieved_flops"], 1),
+                "mfu_est": round(c32["mfu_est"], 2),
+                "compute_dtype": "float32",
+                # >1 means the reduced-precision flagship step is faster
+                "speedup_vs_f32": round(
+                    c32["per_step_ms"] / c["per_step_ms"], 3)
+                if c["per_step_ms"] else None,
+            }
+        except Exception as e:       # comparison is evidence, not a gate
+            fp32_cmp = {"error": f"{type(e).__name__}: {e}"}
+        else:
+            # free the duplicate flagship (params, opt state, compiled
+            # chain) before the HBM-heavy e2e/secondary phases
+            del tr32, c32
+    if fp32_cmp is not None:
+        budget.record({"fp32_compare": fp32_cmp})
     e2e_chain = 4 if on_accel else 2
     if budget.low(90, "e2e_u8"):
         e2e_u8, e2e_detail = None, {"skipped": "budget"}
@@ -685,6 +815,15 @@ def main() -> None:
                                        e2e_steps, device_normalize=1,
                                        chain=e2e_chain)
         budget.record({"e2e_u8_images_per_sec_per_chip": round(e2e_u8, 2)})
+        if e2e_u8:
+            # e2e phase MFU: achieved ips x per-image step FLOPs — shows
+            # how much of the compute-path efficiency the data plane keeps
+            fpi = c["step_tflop"] * 1e12 / batch
+            ach = e2e_u8 * fpi
+            e2e_detail["achieved_flops"] = round(ach, 1)
+            e2e_detail["mfu_est"] = (
+                round(100.0 * ach / 1e12 / c["peak_bf16_tflops"], 2)
+                if c["peak_bf16_tflops"] else 0.0)
     # float path: per-batch dispatch — equally link-bound (doc/
     # e2e_input.md) and a second chain compile would buy nothing
     if budget.low(60, "e2e_f32"):
@@ -767,6 +906,10 @@ def main() -> None:
                             if baseline_ips else None),
             "baseline_basis": basis,
             "mfu_pct": round(mc["mfu_pct"], 2),
+            "mfu_est": round(mc["mfu_est"], 2),
+            "achieved_flops": round(mc["achieved_flops"], 1),
+            "flops_source": mc["flops_source"],
+            "compute_dtype": mc["compute_dtype"],
             "roofline_pct": round(mc["roofline_pct"], 2),
             "arith_intensity": round(mc["arith_intensity"], 1),
             "step_tflop": round(mc["step_tflop"], 4),
@@ -813,6 +956,10 @@ def main() -> None:
         "vs_baseline": round(c["ips"] / BASELINE_IPS, 3),
         "model_tflops": round(c["model_tflops"], 2),
         "mfu_pct": round(c["mfu_pct"], 2),
+        "mfu_est": round(c["mfu_est"], 2),
+        "achieved_flops": round(c["achieved_flops"], 1),
+        "flops_source": c["flops_source"],
+        "compute_dtype": c["compute_dtype"],
         "roofline_pct": round(c["roofline_pct"], 2),
         "arith_intensity": round(c["arith_intensity"], 1),
         "step_tflop": round(c["step_tflop"], 4),
@@ -835,6 +982,7 @@ def main() -> None:
         "decode_pool": dec if dec is not None else {"skipped": "budget"},
         "loss_start": round(c["loss_start"], 4),
         "loss_end": round(c["loss_end"], 4),
+        "fp32_compare": fp32_cmp,
         "models": models,
         "budget_s": args.budget_s,
     })

@@ -141,13 +141,16 @@ def net_get_weight(net: Net, layer: str, tag: str):
 # -- serving engine ----------------------------------------------------------
 
 def create_engine(net: Net, max_batch: int = 64, buckets: str = "",
-                  cache_size: int = 16):
+                  cache_size: int = 16, dtype: str = ""):
     """Engine handle over a net's trained params — gives the C side the
     online-serving capability the reference C API stopped short of
-    (it shipped only offline CXNNetPredict*)."""
+    (it shipped only offline CXNNetPredict*). ``dtype``: serving compute
+    dtype ("bfloat16"/"float16"/"float32"; "" = the net's configured
+    policy) — outputs stay float32 at the ABI either way."""
     return net.create_engine(max_batch=int(max_batch),
                              buckets=buckets or None,
-                             cache_size=int(cache_size))
+                             cache_size=int(cache_size),
+                             dtype=dtype or None)
 
 
 def engine_predict(engine, data, dshape, raw: int = 0):

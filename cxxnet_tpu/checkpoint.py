@@ -32,7 +32,19 @@ def _flatten(prefix: str, tree: Any, out: Dict[str, np.ndarray]) -> None:
         for k, v in tree.items():
             _flatten(f"{prefix}/{k}", v, out)
     else:
-        out[prefix] = np.asarray(tree)
+        out[prefix] = _master_cast(np.asarray(tree))
+
+
+def _master_cast(x: np.ndarray) -> np.ndarray:
+    """Checkpoints always hold fp32 masters. The trainer keeps params and
+    optimizer state fp32 under every compute-dtype policy, so this is
+    normally a no-op — but a custom layer carrying a reduced-precision
+    leaf (bf16/fp16 state, say) must still land as fp32: npz cannot
+    represent ml_dtypes bfloat16 without pickle, and the archive stays
+    dtype-portable (any checkpoint loads under any policy)."""
+    if x.dtype.name in ("bfloat16", "float16"):
+        return x.astype(np.float32)
+    return x
 
 
 def _unflatten(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:

@@ -14,13 +14,79 @@ stream), malformed input raises :class:`ConfigError`.
 
 from __future__ import annotations
 
-from typing import Iterator, List, Tuple
+import dataclasses
+from typing import Any, Iterator, List, Tuple
 
 ConfigPairs = List[Tuple[str, str]]
 
 
 class ConfigError(ValueError):
     """Raised on malformed config input."""
+
+
+# -- mixed-precision compute policy ------------------------------------------
+
+# accepted spellings of the ``compute_dtype`` config value
+_DTYPE_NAMES = {
+    "float32": "float32", "fp32": "float32", "f32": "float32",
+    "bfloat16": "bfloat16", "bf16": "bfloat16",
+    "float16": "float16", "fp16": "float16", "f16": "float16",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """Mixed-precision compute policy threaded through the whole stack.
+
+    ``param_dtype`` is the master-copy dtype: parameters and optimizer
+    state always live in it (fp32), so checkpoints stay dtype-portable.
+    ``compute_dtype`` is what activations/gradients flow in — each layer
+    casts its fp32 params to it at apply time (one fused cast per step
+    inside jit) and runs its matmul/conv in it. ``output_dtype`` is what
+    leaves the model toward the outside world (serve responses, loss
+    values, metric reductions) — fp32. Numerically sensitive interior
+    math stays fp32 regardless of policy: batch/layer-norm statistics,
+    softmax/cross-entropy, attention logits accumulation
+    (``preferred_element_type``), and MoE router probabilities.
+
+    The dtype fields hold jnp dtypes; use :func:`parse_policy` to build
+    one from a config string.
+    """
+    param_dtype: Any
+    compute_dtype: Any
+    output_dtype: Any
+
+    @property
+    def reduced(self) -> bool:
+        """True when compute runs below the fp32 master precision."""
+        return self.compute_dtype != self.param_dtype
+
+    @property
+    def needs_loss_scale(self) -> bool:
+        """fp16's ~6e-5 .. 65504 range underflows small gradients; bf16
+        shares fp32's exponent range and needs no scaling."""
+        import jax.numpy as jnp
+        return self.compute_dtype == jnp.float16
+
+    @property
+    def compute_name(self) -> str:
+        import jax.numpy as jnp
+        return jnp.dtype(self.compute_dtype).name
+
+
+def parse_policy(name: str) -> Policy:
+    """``compute_dtype`` config value -> :class:`Policy` (fp32 masters and
+    outputs, the named compute dtype in between)."""
+    import jax.numpy as jnp
+    canon = _DTYPE_NAMES.get(name.strip().lower())
+    if canon is None:
+        raise ConfigError(
+            f"compute_dtype must be one of float32|bfloat16|float16 "
+            f"(got {name!r})")
+    compute = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+               "float16": jnp.float16}[canon]
+    return Policy(param_dtype=jnp.float32, compute_dtype=compute,
+                  output_dtype=jnp.float32)
 
 
 class _Tokenizer:

@@ -28,7 +28,7 @@ import re
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-from .config import ConfigPairs, ConfigError
+from .config import ConfigPairs, ConfigError, Policy, parse_policy
 
 # Layer-type names accepted by the reference factory (layer.h:323-365).
 KNOWN_LAYER_TYPES = {
@@ -293,3 +293,10 @@ def global_param(cfg: ConfigPairs, name: str, default: str = "") -> str:
         if k == name:
             out = v
     return out
+
+
+def policy_from_config(cfg: ConfigPairs) -> Policy:
+    """Resolve the mixed-precision :class:`~cxxnet_tpu.config.Policy`
+    from the ``compute_dtype`` global (default float32 — reference
+    parity: mshadow real_t, src/global.h)."""
+    return parse_policy(global_param(cfg, "compute_dtype", "float32"))

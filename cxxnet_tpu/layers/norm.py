@@ -30,6 +30,32 @@ import jax.numpy as jnp
 
 from .base import Layer, is_flat, register_layer
 
+def _clamp_check_enabled() -> bool:
+    """Trace-time gate for the variance-clamp telemetry: set
+    CXXNET_BN_CLAMP_WARN=0 to keep the min + cond + host-callback ops
+    out of the compiled step entirely — timed paths (bench) opt out so
+    outfeed-sensitive backends don't pay for diagnostics."""
+    import os
+    return os.environ.get("CXXNET_BN_CLAMP_WARN", "1") != "0"
+
+
+def _warn_variance_clamp(layer, worst):
+    """Host callback: the one-pass E[x^2]-E[x]^2 moment went negative by
+    more than eps on some channel — f32 cancellation is eating variance
+    (|mean| >> std), and the clamp is silently degrading that channel
+    toward inv = rsqrt(eps). Strictly more likely under a reduced compute
+    policy, hence the loud warning (ADVICE r5). Once per layer INSTANCE:
+    two models sharing a layer name must each get their own warning."""
+    if getattr(layer, "_clamp_warned", False):
+        return
+    layer._clamp_warned = True
+    print(f"WARNING batch_norm {layer.name!r}: one-pass variance went "
+          f"negative (min E[x^2]-E[x]^2 = {float(worst):.3e}, beyond eps "
+          f"{layer.eps:.1e}) and was clamped to 0 — f32 cancellation on a "
+          f"large-mean/low-variance channel; normalization degrades "
+          f"toward rsqrt(eps) there. Consider rescaling inputs or "
+          f"raising eps.", flush=True)
+
 
 class _BatchNormBase(Layer):
     moving_avg = True
@@ -106,7 +132,23 @@ class _BatchNormBase(Layer):
             # channel degrades toward inv = rsqrt(eps).
             mean = jnp.mean(xf, axis=axes)
             ex2 = jnp.mean(jnp.square(xf), axis=axes)
-            var = jnp.maximum(ex2 - jnp.square(mean), 0.0)
+            raw_var = ex2 - jnp.square(mean)
+            var = jnp.maximum(raw_var, 0.0)
+            if ctx.stat_sink is None and _clamp_check_enabled():
+                # clamp telemetry (ADVICE r5): a tiny negative is expected
+                # f32 noise, but a clamp beyond eps means real variance
+                # was cancelled away — warn once per layer, host-side.
+                # Skipped inside the pipeline stat-sink path (the stage
+                # bodies run under a custom-vjp lax.switch schedule where
+                # host callbacks are not worth the risk); the moments
+                # merge in the trainer there anyway.
+                worst = jnp.min(raw_var)
+                jax.lax.cond(
+                    worst < -self.eps,
+                    lambda w: jax.debug.callback(
+                        lambda v, _l=self: _warn_variance_clamp(_l, v), w),
+                    lambda w: None,
+                    worst)
             inv = jax.lax.rsqrt(var + self.eps)
             out = (x - mean) * inv * slope + bias
             if self.moving_avg:

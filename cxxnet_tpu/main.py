@@ -375,7 +375,11 @@ class LearnTask:
             self.trainer,
             buckets=gp("serve_buckets", "") or None,
             max_batch=int(gp("serve_max_batch", "64")),
-            cache_size=int(gp("serve_cache_size", "16")))
+            cache_size=int(gp("serve_cache_size", "16")),
+            # serve_dtype: serving-side compute dtype override (e.g.
+            # serve_dtype=bfloat16 to serve an fp32-trained model at the
+            # bf16 matmul rate); default = the net's compute_dtype policy
+            dtype=gp("serve_dtype", "") or None)
         srv = ServeServer(
             engine,
             port=int(gp("serve_port", "8080")),

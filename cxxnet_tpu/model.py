@@ -19,8 +19,8 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from .config import ConfigPairs
-from .graph import NetGraph, global_param
+from .config import ConfigPairs, Policy
+from .graph import NetGraph, global_param, policy_from_config
 from .layers import ApplyCtx, Layer, create_layer
 from .layers.base import Shape3, is_flat, to_nhwc
 
@@ -43,10 +43,11 @@ class Network:
         self.graph = graph
         if graph.input_shape is None:
             raise ValueError("input_shape must be set")
-        cdt = global_param(cfg, "compute_dtype", "float32")
-        self.compute_dtype = {"float32": jnp.float32,
-                              "bfloat16": jnp.bfloat16,
-                              "bf16": jnp.bfloat16}[cdt]
+        # mixed-precision policy: fp32 master params/outputs, activations
+        # and gradients in compute_dtype (config.Policy); per-layer casts
+        # happen at apply time inside jit so XLA fuses them
+        self.policy: Policy = policy_from_config(cfg)
+        self.compute_dtype = self.policy.compute_dtype
         # remat = 1: rematerialize each layer's activations in the backward
         # pass (jax.checkpoint) — trades FLOPs for HBM, the standard TPU
         # recipe for memory-bound models (no reference analog; the closest
@@ -117,14 +118,18 @@ class Network:
               seq_axis: Optional[str] = None,
               data_axis: Optional[str] = None,
               label_slices: Optional[Dict[Tuple[int, int],
-                                          jax.Array]] = None) -> ForwardResult:
+                                          jax.Array]] = None,
+              compute_dtype: Optional[Any] = None) -> ForwardResult:
         """One forward pass. ``data`` is NHWC (batch, y, x, c) or flat
         (batch,1,1,n); ``label`` is (batch, label_width); ``mask`` is (batch,)
         marking real rows (None = all real). ``label_slices`` maps a loss
         layer's global label_vec range to its (pre-sliced) label array —
         used under sequence parallelism, where the full-width label cannot
         be sliced locally with global indices (each shard holds its own
-        token-aligned columns of every slice)."""
+        token-aligned columns of every slice). ``compute_dtype`` overrides
+        the config policy's compute dtype for this call — the serve
+        engine's per-engine ``dtype`` option (a checkpoint trained fp32
+        can serve bf16 and vice versa; fp32 masters make the cast safe)."""
         g = self.graph
         batch = data.shape[0]
         nodes: List[Optional[jax.Array]] = [None] * g.num_nodes
@@ -136,10 +141,11 @@ class Network:
         if rng is None:
             rng = jax.random.PRNGKey(0)
         new_state: NetState = dict(state)
+        cdt = self.compute_dtype if compute_dtype is None else compute_dtype
         total_loss = jnp.zeros((), jnp.float32)
         for li, (spec, layer) in enumerate(zip(g.layers, self.layers)):
             ctx = ApplyCtx(train=train, rng=jax.random.fold_in(rng, li),
-                           compute_dtype=self.compute_dtype,
+                           compute_dtype=cdt,
                            seq_axis=seq_axis, data_axis=data_axis)
             inputs = [nodes[ni] for ni in spec.nindex_in]
             lparams = params.get(layer.name, {})

@@ -137,7 +137,12 @@ def build_hypers(cfg: ConfigPairs) -> Dict[str, UpdaterHyper]:
 
 
 def _prep_grad(g, w, hyper: UpdaterHyper):
-    """NaN-zeroing clip (reference struct clip, sgd_updater-inl.hpp:17-25)."""
+    """NaN-zeroing clip (reference struct clip, sgd_updater-inl.hpp:17-25).
+    Gradients are upcast to the master-param dtype first: under a reduced
+    compute policy the per-param astype transpose already yields fp32
+    grads, but a custom layer returning compute-dtype leaves must not
+    drag the fp32 masters down through the update arithmetic."""
+    g = g.astype(jnp.asarray(w).dtype)
     g = jnp.where(jnp.isnan(g), 0.0, g)
     if hyper.clip_gradient != 0.0:
         g = jnp.clip(g, -hyper.clip_gradient, hyper.clip_gradient)
@@ -167,22 +172,62 @@ def _map_leaves(fn, n_out: int, *trees):
 
 class Optimizer:
     """Pure pytree optimizer dispatching per-leaf by tag; the leaf's dict key
-    ('wmat'/'bias') selects the hyperparameter group."""
+    ('wmat'/'bias') selects the hyperparameter group.
+
+    Mixed precision (``compute_dtype = float16``): the optimizer owns the
+    dynamic loss scaler. Its state is a tiny ``"_mp"`` subtree of
+    ``opt_state`` ({scale fp32, good int32}) so it rides every step
+    family's carry (std jit, sp/pp shard_map, train_chain scan) with no
+    extra dispatch and checkpoints with the rest of the optimizer state.
+    ``update`` then unscales the incoming (loss-scaled) gradients, skips
+    the apply and halves the scale on any inf/nan, and doubles the scale
+    after ``loss_scale_window`` consecutive clean applies. bf16 shares
+    fp32's exponent range and needs none of this (``fp16`` stays False).
+    """
 
     def __init__(self, updater_type: str, cfg: ConfigPairs):
         self.type = updater_type
         if updater_type not in ("sgd", "nag", "adam"):
             raise ValueError(f"unknown updater {updater_type!r}")
         self.hypers = build_hypers(cfg)
+        from .graph import global_param, policy_from_config
+        self.fp16 = policy_from_config(cfg).needs_loss_scale
+        self.ls_init = float(global_param(cfg, "loss_scale_init",
+                                          str(2.0 ** 15)))
+        self.ls_window = int(global_param(cfg, "loss_scale_window", "200"))
+        self.ls_min = float(global_param(cfg, "loss_scale_min", "1.0"))
+        self.ls_max = float(global_param(cfg, "loss_scale_max",
+                                         str(2.0 ** 24)))
 
     # -- state -------------------------------------------------------------
+    def _mp_init(self) -> Dict[str, jax.Array]:
+        return {"scale": jnp.float32(self.ls_init),
+                "good": jnp.zeros((), jnp.int32)}
+
     def init_state(self, params) -> Dict[str, Any]:
         zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
         if self.type == "adam":
-            return {"m1": zeros,
-                    "m2": jax.tree_util.tree_map(jnp.zeros_like, params),
-                    "t": jnp.zeros((), jnp.int32)}
-        return {"mom": zeros}
+            state = {"m1": zeros,
+                     "m2": jax.tree_util.tree_map(jnp.zeros_like, params),
+                     "t": jnp.zeros((), jnp.int32)}
+        else:
+            state = {"mom": zeros}
+        if self.fp16:
+            state["_mp"] = self._mp_init()
+        return state
+
+    def adapt_state(self, opt_state):
+        """Reconcile a loaded/legacy opt state with the current policy:
+        inject fresh loss-scaler state when fp16 training resumes from a
+        non-fp16 checkpoint, drop it on the way back — either way the
+        momentum masters restore untouched (checkpoints stay
+        dtype-portable)."""
+        has = isinstance(opt_state, dict) and "_mp" in opt_state
+        if self.fp16 and not has:
+            return {**opt_state, "_mp": self._mp_init()}
+        if not self.fp16 and has:
+            return {k: v for k, v in opt_state.items() if k != "_mp"}
+        return opt_state
 
     def _tag(self, param_name: str) -> str:
         return tag_for_param(param_name)
@@ -191,19 +236,72 @@ class Optimizer:
         """PartitionSpec tree matching init_state(): momentum/moment buffers
         shard exactly like their params; scalar counters replicate."""
         if self.type == "adam":
-            return {"m1": param_pspecs, "m2": param_pspecs, "t": None}
-        return {"mom": param_pspecs}
+            specs = {"m1": param_pspecs, "m2": param_pspecs, "t": None}
+        else:
+            specs = {"mom": param_pspecs}
+        if self.fp16:
+            specs["_mp"] = {"scale": None, "good": None}
+        return specs
 
     def schedules(self, epoch: int) -> Dict[str, Tuple[float, float]]:
         """Host-side schedule evaluation; pass the result into update()."""
         return {tag: h.schedule(epoch) for tag, h in self.hypers.items()}
 
     # -- update ------------------------------------------------------------
-    def update(self, params, grads, opt_state, sched: Dict[str, Any]):
+    def update(self, params, grads, opt_state, sched: Dict[str, Any],
+               finite_axes: Tuple[str, ...] = ()):
         """Apply one optimizer step. ``sched[tag] = (lr, momentum)`` may be
         python floats or traced scalars. Params may be nested dicts of any
         depth (e.g. pairtest layers hold {'master': {...}, 'slave': {...}});
-        the leaf's dict key determines its tag."""
+        the leaf's dict key determines its tag.
+
+        fp16 policy: ``grads`` arrive loss-scaled; they are upcast to the
+        fp32 masters' dtype and unscaled here, the apply is skipped (and
+        the scale halved) when any gradient is non-finite, and the scale
+        doubles after ``loss_scale_window`` clean applies. ``finite_axes``
+        names manual mesh axes over which gradient leaves are SHARDED
+        (the pp step's FSDP 'pipe' axis) — the overflow flag must agree
+        across them or shards would take different cond branches and the
+        params would silently diverge; replicated-grad axes (data/seq/
+        model, already psum'd) need no entry."""
+        mp = opt_state.get("_mp") if isinstance(opt_state, dict) else None
+        if mp is not None:
+            return self._update_scaled(params, grads, opt_state, sched,
+                                       finite_axes)
+        return self._apply(params, grads, opt_state, sched)
+
+    def _update_scaled(self, params, grads, opt_state, sched, finite_axes):
+        mp = opt_state["_mp"]
+        scale = mp["scale"]
+        # upcast to the fp32 masters BEFORE unscaling: an fp16 leaf (if a
+        # layer ever returned one) would overflow at large scales
+        grads = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32) / scale, grads)
+        finite = jnp.array(True)
+        for g in jax.tree_util.tree_leaves(grads):
+            finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+        for ax in finite_axes:
+            # pmin over bool-as-f32: 1.0 only when EVERY shard is clean
+            finite = jax.lax.pmin(finite.astype(jnp.float32), ax) > 0.5
+        rest = {k: v for k, v in opt_state.items() if k != "_mp"}
+        new_params, new_rest = jax.lax.cond(
+            finite,
+            lambda args: self._apply(*args),
+            lambda args: (args[0], args[2]),
+            (params, grads, rest, sched))
+        good = jnp.where(finite, mp["good"] + 1, jnp.int32(0))
+        grow = jnp.logical_and(finite, good >= self.ls_window)
+        new_scale = jnp.where(
+            finite,
+            jnp.where(grow, jnp.minimum(scale * 2.0, self.ls_max), scale),
+            jnp.maximum(scale * 0.5, self.ls_min))
+        good = jnp.where(grow, jnp.int32(0), good)
+        new_rest = dict(new_rest)
+        new_rest["_mp"] = {"scale": new_scale, "good": good}
+        return new_params, new_rest
+
+    def _apply(self, params, grads, opt_state, sched: Dict[str, Any]):
+        """The raw (unscaled, always-applied) optimizer step."""
         if self.type == "adam":
             t = opt_state["t"] + 1
 

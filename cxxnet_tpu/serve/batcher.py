@@ -188,12 +188,15 @@ class MicroBatcher:
             """When this group must dispatch: the latency window end, or
             earlier when a member's deadline would expire first — a
             timeout_ms shorter than max_latency_ms must be SERVED on an
-            idle queue, not auto-rejected at the window. 1 ms early so
-            dispatch begins before the deadline passes."""
+            idle queue, not auto-rejected at the window. The flush is
+            scheduled 50 ms ahead of the deadline: queue.get wakeups
+            routinely slip several ms past their timeout on a loaded
+            host, and a margin smaller than that slip turns every
+            deadline-driven flush into a rejection race."""
             due = t0 + self.max_latency_s
             dls = [r.deadline for r in reqs if r.deadline is not None]
             if dls:
-                due = min(due, min(dls) - 1e-3)
+                due = min(due, min(dls) - 0.05)
             return due
 
         def flush_due(force: bool = False) -> None:

@@ -26,7 +26,7 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
-from ..config import ConfigPairs, parse_config_string
+from ..config import ConfigPairs, parse_config_string, parse_policy
 from ..trainer import Trainer
 from .. import checkpoint as ckpt
 from .stats import ServingStats
@@ -88,7 +88,8 @@ class InferenceEngine:
                  buckets: Union[str, Sequence[int], None] = None,
                  max_batch: int = 64, cache_size: int = 16,
                  stats: Optional[ServingStats] = None,
-                 layout: str = "NCHW"):
+                 layout: str = "NCHW",
+                 dtype: Optional[str] = None):
         if trainer.params is None:
             raise ValueError("trainer has no params: init_model()/"
                              "load_model() before wrapping")
@@ -101,6 +102,15 @@ class InferenceEngine:
         self.trainer = trainer
         self.stats = stats or ServingStats()
         self.layout = layout
+        # serving compute dtype: an engine-level constant (part of no
+        # cache key — every compiled cell shares it). Defaults to the
+        # net's configured policy; an explicit ``dtype`` overrides, so a
+        # checkpoint trained fp32 can SERVE bf16 (params are fp32
+        # masters either way — the cast happens inside the compiled
+        # predictor). Responses always leave as the policy's fp32
+        # output dtype.
+        self.compute_dtype = (parse_policy(dtype).compute_dtype
+                              if dtype else trainer.net.compute_dtype)
         dp = trainer.mesh.data_parallel
         self.max_batch = int(max_batch)
         self.buckets = _parse_buckets(buckets, self.max_batch, dp)
@@ -205,25 +215,32 @@ class InferenceEngine:
         import jax
         import jax.numpy as jnp
         net = self.trainer.net
+        cdt = self.compute_dtype
+        # responses leave in the policy's fp32 output dtype even when the
+        # interior ran bf16/fp16 (callers see stable numerics; JSON/C
+        # marshalling stays float32 everywhere)
+        out_dt = net.policy.output_dtype
 
         if kind == "extract":
             def fn(params, state, data):
                 res = net.apply(params, state, data, train=False,
-                                capture_nodes=True)
+                                capture_nodes=True, compute_dtype=cdt)
                 v = res.out if node in ("top", "top[-1]") \
                     else res.nodes[node]
-                return v.reshape(v.shape[0], -1)
+                return v.reshape(v.shape[0], -1).astype(out_dt)
         elif kind == "raw":
             def fn(params, state, data):
-                res = net.apply(params, state, data, train=False)
-                return res.out.reshape(res.out.shape[0], -1)
+                res = net.apply(params, state, data, train=False,
+                                compute_dtype=cdt)
+                return res.out.reshape(res.out.shape[0], -1).astype(out_dt)
         else:                                   # "predict"
             def fn(params, state, data):
-                res = net.apply(params, state, data, train=False)
+                res = net.apply(params, state, data, train=False,
+                                compute_dtype=cdt)
                 out = res.out.reshape(res.out.shape[0], -1)
                 if out.shape[1] == 1:
-                    return out[:, 0]
-                return jnp.argmax(out, axis=1).astype(jnp.float32)
+                    return out[:, 0].astype(out_dt)
+                return jnp.argmax(out, axis=1).astype(out_dt)
         return jax.jit(fn)
 
     # -- inference -------------------------------------------------------

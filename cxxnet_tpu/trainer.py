@@ -70,28 +70,59 @@ def _chain_scan(one, length):
     return step
 
 
-def _apply_accum(opt, period, params, opt_state, accum, sched):
+def _scaled_value_and_grad(loss_fn, params, opt_state):
+    """value_and_grad with fp16 dynamic loss scaling. When the optimizer
+    state carries a ``"_mp"`` scaler (compute_dtype = float16), the
+    differentiated loss is multiplied by the current scale — so small
+    fp16 gradients clear the subnormal floor — and the RETURNED loss is
+    divided back (the scale is a power of two, so the division is exact).
+    Gradients stay scaled here; Optimizer.update unscales them and
+    handles the overflow skip/halve. bf16/fp32 policies have no "_mp"
+    entry and take the plain path, identical bit-for-bit to before."""
+    mp = opt_state.get("_mp") if isinstance(opt_state, dict) else None
+    if mp is None:
+        return jax.value_and_grad(loss_fn, has_aux=True)(params)
+    scale = mp["scale"]
+
+    def scaled(p):
+        loss, aux = loss_fn(p)
+        return loss * scale, aux
+    (loss, aux), grads = jax.value_and_grad(scaled, has_aux=True)(params)
+    return (loss / scale, aux), grads
+
+
+def _apply_accum(opt, period, params, opt_state, accum, sched,
+                 finite_axes=()):
     """The period-boundary apply: scale the accumulated grads, step the
     optimizer, zero the accumulator. ONE definition shared by the
     static (update) and traced (accumulating-chain lax.cond) callers so
-    the two paths cannot silently diverge."""
+    the two paths cannot silently diverge. The accumulator is fp32 (it
+    starts as zeros_like the fp32 masters and jnp.add promotes), so
+    update_period composes with every compute-dtype policy; under fp16
+    it holds loss-SCALED sums that Optimizer.update unscales at apply."""
     scaled = jax.tree_util.tree_map(lambda g: g / period, accum)
-    params, opt_state = opt.update(params, scaled, opt_state, sched)
+    params, opt_state = opt.update(params, scaled, opt_state, sched,
+                                   finite_axes=finite_axes)
     return params, opt_state, jax.tree_util.tree_map(
         jnp.zeros_like, accum)
 
 
 def _apply_grads(opt, period, do_update, params, opt_state, accum, grads,
-                 sched):
+                 sched, finite_axes=()):
     """Gradient accumulation (update_period) + optimizer step — shared by
-    the GSPMD and shard_map train-step builders."""
+    the GSPMD and shard_map train-step builders. ``finite_axes``: manual
+    mesh axes over which gradient LEAVES are sharded (pp's FSDP 'pipe'
+    axis) — threaded to the fp16 overflow check so every shard agrees on
+    skip-vs-apply (see Optimizer.update)."""
     if period > 1:
         accum = jax.tree_util.tree_map(jnp.add, accum, grads)
         if do_update:
             params, opt_state, accum = _apply_accum(
-                opt, period, params, opt_state, accum, sched)
+                opt, period, params, opt_state, accum, sched,
+                finite_axes=finite_axes)
     else:
-        params, opt_state = opt.update(params, grads, opt_state, sched)
+        params, opt_state = opt.update(params, grads, opt_state, sched,
+                                       finite_axes=finite_axes)
     return params, opt_state, accum
 
 
@@ -100,6 +131,9 @@ class Trainer:
         self.cfg = list(cfg)
         self.graph = build_graph(cfg)
         self.net = Network(self.graph, cfg)
+        # mixed-precision policy (config.Policy): fp32 masters, layers
+        # compute in policy.compute_dtype, loss/metrics/outputs fp32
+        self.policy = self.net.policy
         gp = lambda n, d: global_param(cfg, n, d)
         self.batch_size = int(gp("batch_size", "128"))
         self.update_period = int(gp("update_period", "1"))
@@ -399,6 +433,10 @@ class Trainer:
         ckpt.check_structure(blob["meta"], self.graph.structure_signature())
         opt = blob["opt"] if blob["opt"] is not None \
             else self.optimizer.init_state(blob["params"])
+        # checkpoints are policy-portable: the fp32 masters restore as-is
+        # and the fp16 loss-scaler subtree is injected/dropped to match
+        # the CURRENT compute_dtype policy
+        opt = self.optimizer.adapt_state(opt)
         self.params, self.net_state, self.opt_state = self._place(
             blob["params"], blob["state"], opt)
         self._init_accum(blob["params"])
@@ -545,8 +583,8 @@ class Trainer:
                 loss = jax.lax.pmean(
                     jax.lax.pmean(res.loss, seq_axis), data_axis)
                 return loss, (res.state, _collect_nodes(res, needed))
-            (loss, (new_state, nodes)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
+            (loss, (new_state, nodes)), grads = _scaled_value_and_grad(
+                loss_fn, params, opt_state)
             # layer state computed from local shards (e.g. the MoE
             # load-balance aux loss) must leave the shard_map replicated
             new_state = jax.tree_util.tree_map(
@@ -1065,8 +1103,8 @@ class Trainer:
                 # seed/psum pairing the data axis uses (and the seq axis
                 # under the sequence-parallel pipeline)
                 return jax.lax.pmean(loss, mean_axes), (top, stats)
-            (loss, (out, stats)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(full)
+            (loss, (out, stats)), grads = _scaled_value_and_grad(
+                loss_fn, full, opt_state)
             # manual-tp grad merge: psum over 'model' for EVERY leaf —
             # planned leaves hold partial (zero-padded slice) grads,
             # unplanned leaves hold 1/tp-scaled replicas; both sum to the
@@ -1114,9 +1152,12 @@ class Trainer:
                     new_state = dict(net_state)
                 for name, layer in tick_layers.items():
                     new_state[name] = layer.state_tick(net_state[name])
+            # grads here are per-pipe FSDP shards (post-scatter): the fp16
+            # overflow flag must be agreed over 'pipe' or members would
+            # take different skip/apply branches
             params, opt_state, accum = _apply_grads(
                 opt, period, do_update, params, opt_state, accum, grads,
-                sched)
+                sched, finite_axes=(pipe_axis,))
             return (params, opt_state, new_state, accum, loss, nodes,
                     jax.random.fold_in(rng, 1))
 
@@ -1224,20 +1265,22 @@ class Trainer:
         needed = self._needed_nodes() if (bank or not chain) else []
         capture = bool(needed)
 
-        def fwd_bwd(params, net_state, data, label, mask, extra, rng):
+        def fwd_bwd(params, opt_state, net_state, data, label, mask,
+                    extra, rng):
             # ONE forward/backward body shared by the plain and the
             # accumulating chain step — keeps the two numerically locked
+            # (opt_state is read-only here: the fp16 loss scale rides it)
             def loss_fn(p):
                 res = net.apply(p, net_state, data, label, mask,
                                 extra_data=extra, rng=rng, train=True,
                                 capture_nodes=capture)
                 return res.loss, (res.state, _collect_nodes(res, needed))
-            return jax.value_and_grad(loss_fn, has_aux=True)(params)
+            return _scaled_value_and_grad(loss_fn, params, opt_state)
 
         def one(params, opt_state, net_state, accum, data, label, mask,
                 extra, rng, sched):
             (loss, (new_state, nodes)), grads = fwd_bwd(
-                params, net_state, data, label, mask, extra, rng)
+                params, opt_state, net_state, data, label, mask, extra, rng)
             params, opt_state, accum = _apply_grads(
                 opt, period, do_update, params, opt_state, accum, grads,
                 sched)
@@ -1253,7 +1296,7 @@ class Trainer:
             # the period boundary — chains need not align with periods
             def one_acc(p, o, s, a, c, d, l, m, e, r, sc):
                 (loss, (new_state, nodes)), grads = fwd_bwd(
-                    p, s, d, l, m, e, r)
+                    p, o, s, d, l, m, e, r)
                 a = jax.tree_util.tree_map(jnp.add, a, grads)
 
                 p, o, a = jax.lax.cond(

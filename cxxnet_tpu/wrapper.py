@@ -238,7 +238,9 @@ class Net:
         """Wrap this net's trained params into a serve.InferenceEngine
         (bucketed compile cache + predict/predict_raw/extract) — the
         online-serving capability the C API never had. Keyword args pass
-        through (buckets, max_batch, cache_size, stats)."""
+        through (buckets, max_batch, cache_size, stats, dtype — the
+        serving compute dtype, e.g. dtype='bfloat16' to serve an
+        fp32-trained model at the bf16 matmul rate with fp32 outputs)."""
         from .serve.engine import InferenceEngine
         kw.setdefault("layout", self._layout)
         return InferenceEngine(self._require(), **kw)
@@ -248,7 +250,9 @@ def create_engine(cfg: Union[str, ConfigPairs], model_path: str,
                   dev: str = "", layout: str = "NCHW", **kw):
     """One-call engine construction from a net config + checkpoint:
     optimizer state is stripped before device placement
-    (checkpoint.load_for_inference)."""
+    (checkpoint.load_for_inference). ``dtype='bfloat16'`` (kw) serves
+    the fp32 master weights at a reduced compute dtype — checkpoints
+    are policy-portable, so any checkpoint can serve at any dtype."""
     from .serve.engine import InferenceEngine
     pairs = parse_config_string(cfg) if isinstance(cfg, str) else list(cfg)
     if dev:

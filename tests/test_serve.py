@@ -216,14 +216,15 @@ def test_short_deadline_served_when_idle(engine):
     # member deadline, not only at the window end)
     b = MicroBatcher(engine, max_batch=16, max_latency_ms=10_000)
     t0 = time.perf_counter()
-    # 500 ms: far below the 10 s window, but wide enough that worker
-    # wakeup jitter under a loaded CPU can't push dispatch past it
-    fut = b.submit(rows(1), timeout_ms=500)
+    # 2 s: far below the 10 s window, but wide enough that worker wakeup
+    # jitter under a loaded CPU (full-suite runs) can't push dispatch
+    # past the deadline and flip the outcome to rejection
+    fut = b.submit(rows(1), timeout_ms=2000)
     out = fut.result(timeout=30)
     took = time.perf_counter() - t0
     b.close()
     assert out.shape == (1,)
-    assert took < 5.0, "flush must come from the deadline, not the window"
+    assert took < 8.0, "flush must come from the deadline, not the window"
     assert engine.stats.rejected_deadline == 0
 
 

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Mixed-precision smoke check (tier-1-adjacent; CPU-safe).
+
+Trains one tiny round with ``compute_dtype = bfloat16`` (fp32 master
+weights, bf16 activations/gradients) and serves the checkpoint on CPU:
+
+  1. training loss is finite and the masters stay fp32;
+  2. the served engine (bf16 compute, fp32 outputs) answers /predict
+     and /predict_raw with finite float32 values;
+  3. a second burst of same-shape requests causes ZERO steady-state
+     recompiles (compile-cache misses stay at one per bucket+kind cell).
+
+Exits nonzero on any failure.  Run:  JAX_PLATFORMS=cpu python tools/smoke_bf16.py
+(sibling of tools/smoke_serve.py — same harness, dtype-policy focus)
+"""
+
+import os
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+NET_CFG = """
+netconfig=start
+layer[+1:h1] = fullc:fc1
+  nhidden = 32
+  random_type = xavier
+layer[+1:a1] = relu
+layer[a1->out] = fullc:fc2
+  nhidden = 5
+  random_type = xavier
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,16
+batch_size = 64
+eta = 0.3
+dev = cpu
+eval_train = 0
+compute_dtype = bfloat16
+"""
+
+SYN_ITER = """
+iter = synthetic
+num_inst = 512
+batch_size = 64
+num_class = 5
+input_shape = 1,1,16
+seed_data = 3
+"""
+
+
+def main() -> int:
+    import numpy as np
+    import jax.numpy as jnp
+    from cxxnet_tpu.config import parse_config_string
+    from cxxnet_tpu.io.data import create_iterator
+    from cxxnet_tpu.trainer import Trainer
+    from cxxnet_tpu import wrapper
+
+    # 1 tiny bf16 training round -> finite loss, fp32 masters
+    tr = Trainer(parse_config_string(NET_CFG))
+    assert tr.policy.compute_name == "bfloat16", tr.policy
+    tr.init_model()
+    for batch in create_iterator(parse_config_string(SYN_ITER)):
+        tr.update(batch)
+    loss = float(tr.last_loss)
+    assert np.isfinite(loss), f"bf16 training loss not finite: {loss}"
+    import jax
+    for leaf in jax.tree_util.tree_leaves(tr.params):
+        assert jnp.asarray(leaf).dtype == jnp.float32, \
+            f"master param leaf not fp32: {leaf.dtype}"
+    for leaf in jax.tree_util.tree_leaves(tr.opt_state):
+        assert jnp.asarray(leaf).dtype in (jnp.float32, jnp.int32), \
+            f"optimizer state leaf not fp32/int32: {leaf.dtype}"
+
+    with tempfile.TemporaryDirectory() as td:
+        model = os.path.join(td, "0000.model")
+        tr.save_model(model)
+
+        # serve the checkpoint with bf16 compute (engine dtype override
+        # exercises the policy-portable path: fp32 masters, bf16 interior,
+        # fp32 outputs at the API)
+        engine = wrapper.create_engine(NET_CFG, model, buckets="4,8",
+                                       max_batch=8, dtype="bfloat16")
+        assert engine.compute_dtype == jnp.bfloat16, engine.compute_dtype
+
+        rng = np.random.RandomState(0)
+        # burst 1: two sizes -> two buckets (3->4, 7->8), one compile each
+        p3 = engine.predict(rng.randn(3, 16))
+        p7 = engine.predict(rng.randn(7, 16))
+        raw = engine.predict_raw(rng.randn(3, 16))
+        assert p3.shape == (3,) and p7.shape == (7,), (p3.shape, p7.shape)
+        assert raw.shape == (3, 5) and raw.dtype == np.float32, \
+            (raw.shape, raw.dtype)
+        for v in (p3, p7, raw):
+            assert np.all(np.isfinite(np.asarray(v, np.float64))), \
+                "bf16 serving produced non-finite values"
+        snap1 = engine.stats.snapshot()
+        misses1 = snap1["compile_cache"]["misses"]
+        assert misses1 == 3, \
+            f"expected 3 compiles (predict@4, predict@8, raw@4): {misses1}"
+
+        # burst 2: same shapes again -> zero steady-state recompiles
+        for _ in range(3):
+            engine.predict(rng.randn(3, 16))
+            engine.predict(rng.randn(7, 16))
+            engine.predict_raw(rng.randn(3, 16))
+        misses2 = engine.stats.snapshot()["compile_cache"]["misses"]
+        assert misses2 == misses1, \
+            f"steady-state recompiled: {misses1} -> {misses2}"
+
+    print(f"smoke_bf16 OK: loss={loss:.4f} compiles={misses2} "
+          f"(zero steady-state recompiles, fp32 masters, finite bf16 serve)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
