@@ -12,19 +12,40 @@ Format here: a single ``.model`` file = npz archive of flattened
 param/state/opt arrays plus a JSON metadata blob (structure signature, round,
 counters). Optimizer state IS checkpointed (save_opt_state=1 default) — an
 improvement over the reference, which silently drops momentum on resume.
+
+Integrity: the meta blob carries a per-array sha256 digest map; loads
+verify by default (``verify=False`` opts out) and raise
+:class:`CheckpointCorrupt` on any mismatch or torn archive, so a
+checkpoint truncated by a killed run can never restore silently-wrong
+weights. ``find_latest_valid`` is the resume scan that SKIPS corrupt /
+truncated / ``.tmp``-orphaned files and falls back to the previous
+round — what ``continue=1`` and the sentinel's rollback both use.
 """
 
 from __future__ import annotations
 
+import hashlib
 import io
 import json
 import os
 import re
-from typing import Any, Dict, Optional, Tuple
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from .io import stream
+from .resilience import counters, failpoints
+
+
+class CheckpointCorrupt(IOError):
+    """The archive is torn, truncated, or fails digest verification."""
+
+
+# tmp files younger than this are presumed to belong to a LIVE writer in
+# another process and are never swept (a checkpoint write takes seconds
+# to low minutes; a crash-orphan only gets older)
+TMP_SWEEP_MIN_AGE_S = 600.0
 
 
 def _flatten(prefix: str, tree: Any, out: Dict[str, np.ndarray]) -> None:
@@ -58,20 +79,41 @@ def _unflatten(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
     return tree
 
 
+def _digest(arr: np.ndarray) -> str:
+    """sha256 over dtype + shape + raw bytes: a bit flip, a short read,
+    AND a silently reshaped/retyped array all change the digest."""
+    h = hashlib.sha256()
+    arr = np.ascontiguousarray(arr)
+    h.update(f"{arr.dtype.str}:{arr.shape}:".encode("ascii"))
+    h.update(arr.tobytes())
+    return h.hexdigest()
+
+
 def save_model(path: str, *, structure_sig: tuple, round_counter: int,
                epoch_counter: int, params: Any, net_state: Any,
-               opt_state: Optional[Any] = None) -> None:
+               opt_state: Optional[Any] = None, step_count: int = 0,
+               lr_scale: float = 1.0) -> None:
+    failpoints.check("ckpt.write", IOError)
     arrays: Dict[str, np.ndarray] = {}
     _flatten("params", jax_to_numpy(params), arrays)
     _flatten("state", jax_to_numpy(net_state), arrays)
     if opt_state is not None:
         _flatten("opt", jax_to_numpy(opt_state), arrays)
     meta = {
-        "format_version": 1,
+        "format_version": 2,
         "structure_sig": _sig_to_json(structure_sig),
         "round": round_counter,
         "epoch": epoch_counter,
+        # rng-stream position: restore re-derives the key from
+        # fold_in(base_key, step_count), so rollback resumes the SAME
+        # dropout/shuffle stream it would have had (Trainer.load_model)
+        "step_count": int(step_count),
+        # sentinel LR backoff survives a crash: resuming a run whose LR
+        # was halved after rollbacks must NOT restart at full LR (a
+        # deterministically spiking run would crash-loop otherwise)
+        "lr_scale": float(lr_scale),
         "has_opt": opt_state is not None,
+        "digests": {k: _digest(v) for k, v in arrays.items()},
     }
     arrays["__meta__"] = np.frombuffer(
         json.dumps(meta).encode("utf-8"), dtype=np.uint8)
@@ -82,20 +124,47 @@ def save_model(path: str, *, structure_sig: tuple, round_counter: int,
     stream.write_bytes_atomic(path, buf.getvalue())
 
 
-def _load_groups(path: str, include_opt: bool):
+def _load_groups(path: str, include_opt: bool, verify: bool = True):
     """Shared checkpoint reader: with ``include_opt=False`` the ``opt/``
-    members are never even decompressed from the archive."""
-    if stream.is_remote(path):
-        # remote: one ranged read into memory, then unpack
-        with stream.sopen(path, "rb") as f:
-            src = io.BytesIO(f.read())
-    else:
-        src = path                   # local: let np.load stream members
-    with np.load(src, allow_pickle=False) as z:
-        arrays = {k: z[k] for k in z.files
-                  if include_opt or k == "__meta__"
-                  or not k.startswith("opt/")}
-    meta = json.loads(bytes(arrays.pop("__meta__")).decode("utf-8"))
+    members are never even decompressed from the archive. ``verify``
+    recomputes each loaded array's sha256 against the meta digest map
+    (format_version >= 2; older archives have no digests and only get
+    the torn-archive structural checks)."""
+    import zipfile
+    try:
+        if stream.is_remote(path) or failpoints.armed_prefix("io."):
+            # remote: one ranged (retried) read into memory, then unpack;
+            # armed io.* failpoints route local reads here too so chaos
+            # tests exercise the same retry path without an object store
+            src = io.BytesIO(stream.read_bytes(path))
+        else:
+            src = path               # local: let np.load stream members
+        with np.load(src, allow_pickle=False) as z:
+            arrays = {k: z[k] for k in z.files
+                      if include_opt or k == "__meta__"
+                      or not k.startswith("opt/")}
+        if "__meta__" not in arrays:
+            raise CheckpointCorrupt(f"{path}: archive has no meta blob")
+        meta = json.loads(bytes(arrays.pop("__meta__")).decode("utf-8"))
+    except (zipfile.BadZipFile, ValueError, KeyError, EOFError,
+            json.JSONDecodeError) as e:
+        # np.load raises these on truncated/torn archives; a checkpoint
+        # that cannot be parsed is corrupt, not a programming error
+        raise CheckpointCorrupt(f"{path}: torn checkpoint archive "
+                                f"({type(e).__name__}: {e})") from e
+    if verify:
+        digests = meta.get("digests")
+        if digests is not None:
+            for k, v in arrays.items():
+                want = digests.get(k)
+                if want is None:
+                    raise CheckpointCorrupt(
+                        f"{path}: array {k!r} missing from digest map")
+                got = _digest(v)
+                if got != want:
+                    raise CheckpointCorrupt(
+                        f"{path}: digest mismatch for {k!r} "
+                        f"(want {want[:12]}.., got {got[:12]}..)")
     groups: Dict[str, Dict[str, np.ndarray]] = {"params": {}, "state": {}}
     if include_opt:
         groups["opt"] = {}
@@ -105,27 +174,36 @@ def _load_groups(path: str, include_opt: bool):
     return meta, groups
 
 
-def load_model(path: str) -> Dict[str, Any]:
-    meta, groups = _load_groups(path, include_opt=True)
-    return {
-        "meta": meta,
-        "params": _unflatten(groups["params"]) if groups["params"] else {},
-        "state": _unflatten(groups["state"]) if groups["state"] else {},
-        "opt": _unflatten(groups["opt"]) if groups["opt"] else None,
-    }
+def load_model(path: str, verify: bool = True) -> Dict[str, Any]:
+    meta, groups = _load_groups(path, include_opt=True, verify=verify)
+    return _blob_from_groups(meta, groups)
 
 
-def load_for_inference(path: str) -> Dict[str, Any]:
+def load_for_inference(path: str, verify: bool = True) -> Dict[str, Any]:
     """Load a checkpoint for serving: params + layer state only — an
     inference engine never steps the optimizer, and momentum buffers
     would double the model's host/device bytes at load time
     (serve/engine.py builds on this)."""
-    meta, groups = _load_groups(path, include_opt=False)
-    return {
+    meta, groups = _load_groups(path, include_opt=False, verify=verify)
+    return _blob_from_groups(meta, groups)
+
+
+def _blob_from_groups(meta, groups) -> Dict[str, Any]:
+    blob = {
         "meta": meta,
         "params": _unflatten(groups["params"]) if groups["params"] else {},
         "state": _unflatten(groups["state"]) if groups["state"] else {},
     }
+    if "opt" in groups:      # inference loads carry NO opt key at all
+        blob["opt"] = _unflatten(groups["opt"]) if groups["opt"] else None
+    return blob
+
+
+def verify_model(path: str) -> Dict[str, Any]:
+    """Full integrity pass (every group, digests included); returns the
+    meta dict, raises :class:`CheckpointCorrupt` / OSError otherwise."""
+    meta, _ = _load_groups(path, include_opt=True, verify=True)
+    return meta
 
 
 def check_structure(meta: Dict[str, Any], structure_sig: tuple) -> None:
@@ -150,19 +228,96 @@ def model_path(model_dir: str, round_counter: int) -> str:
     return os.path.join(model_dir, "%04d.model" % round_counter)
 
 
+# %04d zero-pads but does NOT truncate: round 10000 writes "10000.model",
+# so the scan must accept 4+ digits or long runs silently resume from 9999
+_MODEL_RE = re.compile(r"^(\d{4,})\.model$")
+
+
+def _scan_rounds(model_dir: str) -> List[Tuple[int, str]]:
+    """All (round, path) checkpoints in model_dir, newest first."""
+    if not stream.isdir(model_dir):
+        return []
+    out = []
+    for fn in stream.listdir(model_dir):
+        m = _MODEL_RE.match(fn)
+        if m:
+            out.append((int(m.group(1)), os.path.join(model_dir, fn)))
+    out.sort(reverse=True)
+    return out
+
+
 def find_latest(model_dir: str) -> Optional[Tuple[int, str]]:
     """Scan model_dir for the newest %04d.model (reference SyncLastestModel).
-    model_dir may be a remote URL (gs:// etc)."""
-    if not stream.isdir(model_dir):
-        return None
-    best = None
-    for fn in stream.listdir(model_dir):
-        m = re.match(r"^(\d{4})\.model$", fn)
-        if m:
-            r = int(m.group(1))
-            if best is None or r > best[0]:
-                best = (r, os.path.join(model_dir, fn))
-    return best
+    model_dir may be a remote URL (gs:// etc). No integrity check — use
+    :func:`find_latest_valid` for resume/rollback decisions."""
+    rounds = _scan_rounds(model_dir)
+    return rounds[0] if rounds else None
+
+
+def find_latest_valid(model_dir: str, sweep_tmp: bool = True,
+                      verbose: bool = False, want_blob: bool = False):
+    """The resume scan ``continue=1`` and sentinel rollback rely on:
+    newest checkpoint that PASSES verification, skipping corrupt or
+    truncated files (each skip counted under ``ckpt.skipped_invalid``)
+    and falling back round by round. ``sweep_tmp`` also deletes stale
+    ``*.tmp*`` orphans left by writers killed between tmp-write and
+    rename (this process's own tmp files excluded — a live async save
+    thread may own one) — they are never valid checkpoints and a pile
+    of them is how crash loops fill disks.
+
+    Returns ``(round, path)`` — or ``(round, path, blob)`` with
+    ``want_blob=True`` so the caller restores from the bytes the
+    verification pass ALREADY read instead of re-reading the archive
+    (halves resume/rollback IO on multi-GB remote checkpoints)."""
+    if sweep_tmp and stream.isdir(model_dir):
+        own_suffix = f".tmp.{os.getpid()}"
+        for fn in stream.listdir(model_dir):
+            # never touch THIS process's tmp files (an async save thread
+            # may be mid-write; the pid suffix only separates processes),
+            # and never touch a FRESH tmp from another process — a serve
+            # or resume job sharing model_dir with a live trainer must
+            # not delete its in-progress write (os.remove succeeds on
+            # open files; only age proves the writer is dead)
+            if ".tmp" in fn and not fn.endswith(own_suffix):
+                path = os.path.join(model_dir, fn)
+                try:
+                    if time.time() - stream.getmtime(path) \
+                            < TMP_SWEEP_MIN_AGE_S:
+                        continue
+                    stream.remove(path)
+                    counters.inc("ckpt.tmp_swept")
+                    if verbose:
+                        print(f"checkpoint scan: swept orphan {fn}")
+                except OSError:
+                    pass             # racing writer owns it; leave it be
+    for r, path in _scan_rounds(model_dir):
+        try:
+            meta, groups = _load_groups(path, include_opt=True,
+                                        verify=True)
+            if want_blob:
+                return (r, path, _blob_from_groups(meta, groups))
+            return (r, path)
+        except (CheckpointCorrupt, OSError) as e:
+            counters.inc("ckpt.skipped_invalid")
+            if verbose:
+                print(f"checkpoint scan: skipping invalid {path}: {e}")
+    return None
+
+
+def rotate_checkpoints(model_dir: str, keep_last_n: int) -> List[str]:
+    """Delete all but the newest ``keep_last_n`` checkpoints (0 = keep
+    everything). Returns the deleted paths. Deletion failures are
+    non-fatal — rotation is hygiene, not correctness."""
+    if keep_last_n <= 0:
+        return []
+    deleted = []
+    for _r, path in _scan_rounds(model_dir)[keep_last_n:]:
+        try:
+            stream.remove(path)
+            deleted.append(path)
+        except OSError:
+            pass
+    return deleted
 
 
 def _tree_matches(dst: Any, src: Any) -> bool:

@@ -74,6 +74,53 @@ class Policy:
         return jnp.dtype(self.compute_dtype).name
 
 
+# -- IO retry policy ----------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Backoff knobs for transient-IO retry (resilience.retry_call),
+    applied by io/stream.py to every remote operation. Defaults: 4
+    attempts, 50 ms -> 2 s full-jitter exponential backoff."""
+    attempts: int = 4
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    jitter: float = 1.0          # 0 = deterministic backoff, 1 = full jitter
+
+
+def parse_retry_policy(cfg: ConfigPairs) -> RetryPolicy:
+    """Build a :class:`RetryPolicy` from ``io_retry_attempts`` /
+    ``io_retry_base_ms`` / ``io_retry_max_ms`` / ``io_retry_jitter``
+    config keys (last occurrence wins, like every global key)."""
+    known = {"io_retry_attempts", "io_retry_base_ms", "io_retry_max_ms",
+             "io_retry_jitter"}
+    vals = {}
+    for name, val in cfg:
+        if name.startswith("io_retry_"):
+            if name not in known:
+                # a typo'd retry knob silently falling back to defaults
+                # is exactly the kind of quiet misconfiguration this
+                # namespace check is cheap insurance against
+                raise ConfigError(
+                    f"unknown retry setting {name!r}; valid keys: "
+                    + ", ".join(sorted(known)))
+            vals[name] = val
+    try:
+        pol = RetryPolicy(
+            attempts=int(vals.get("io_retry_attempts", "4")),
+            base_delay_s=float(vals.get("io_retry_base_ms", "50")) / 1e3,
+            max_delay_s=float(vals.get("io_retry_max_ms", "2000")) / 1e3,
+            jitter=float(vals.get("io_retry_jitter", "1.0")))
+    except ValueError as e:
+        raise ConfigError(f"bad io_retry_* value: {e}")
+    if pol.attempts < 1:
+        raise ConfigError(
+            f"io_retry_attempts must be >= 1, got {pol.attempts}")
+    if not 0.0 <= pol.jitter <= 1.0:
+        raise ConfigError(
+            f"io_retry_jitter must be in [0, 1], got {pol.jitter}")
+    return pol
+
+
 def parse_policy(name: str) -> Policy:
     """``compute_dtype`` config value -> :class:`Policy` (fp32 masters and
     outputs, the named compute dtype in between)."""

@@ -24,6 +24,7 @@ from typing import BinaryIO, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..resilience import counters, failpoints
 from .stream import getsize, sopen
 
 MAGIC = 0xCED7ABEF
@@ -80,14 +81,27 @@ class RecordReader:
     dmlc::InputSplit used at iter_image_recordio-inl.hpp:168-186 (each
     worker reads [part*size/n, (part+1)*size/n) resynced to record
     boundaries).
+
+    Corruption handling: a record whose frame is damaged (bad magic —
+    a flipped byte, a torn rewrite) is SKIPPED via the same ``_resync``
+    machinery the shard boundaries use, counted on ``self.skipped`` and
+    the process-wide ``recordio.skipped`` counter, and bounded by
+    ``max_skip`` — past the bound the file is declared rotten and the
+    read raises (one bad sector is survivable; a file that is mostly
+    bad sectors is a data bug someone must see). A truncated FINAL
+    record (killed packer) ends the shard silently, exactly like a
+    shard boundary.
     """
 
-    def __init__(self, path: str, part: int = 0, nsplit: int = 1):
+    def __init__(self, path: str, part: int = 0, nsplit: int = 1,
+                 max_skip: int = 100):
         self.path = path
         size = getsize(path)
         self._f = sopen(path, "rb")
         self.begin = size * part // nsplit
         self.end = size * (part + 1) // nsplit
+        self.max_skip = int(max_skip)
+        self.skipped = 0
         self._resync(self.begin)
 
     def _resync(self, pos: int) -> None:
@@ -118,6 +132,25 @@ class RecordReader:
             pos += len(chunk) - 7
         self._f.seek(self.end)
 
+    def _skip_corrupt(self, at: int, why: str, resync: bool = True
+                      ) -> None:
+        """Account one corrupt record (and by default resync past it);
+        raise once the bound is exhausted (an unbounded skip would
+        happily 'read' a file of zeros as an empty dataset).
+        ``resync=False`` when the file position already sits at the
+        next record (decode-level faults with an intact frame)."""
+        self.skipped += 1
+        counters.inc("recordio.skipped")
+        if self.skipped > self.max_skip:
+            raise IOError(
+                f"{self.path}: {self.skipped} corrupt records exceed "
+                f"max_skip={self.max_skip} (last at byte {at}: {why}); "
+                "repack the file")
+        if resync:
+            # the damaged frame starts at an 8-aligned offset; resume
+            # the magic scan at the NEXT aligned slot to skip it
+            self._resync(at + 8)
+
     def __iter__(self) -> Iterator[bytes]:
         while True:
             at = self._f.tell()
@@ -128,15 +161,34 @@ class RecordReader:
                 return
             magic, ln = _HDR.unpack(hdr)
             if magic != MAGIC:
-                raise IOError(f"{self.path}: bad record magic at {at}")
+                self._skip_corrupt(at, "bad record magic")
+                continue
             payload = self._f.read(ln)
             if len(payload) < ln:
-                return
+                # short read: a genuinely torn TAIL (killed packer) ends
+                # the shard silently — but a corrupted length field
+                # mid-file reads to EOF the same way and must not drop
+                # the rest of the shard uncounted. Resync decides:
+                # another record past this point proves mid-file
+                # corruption.
+                self._resync(at + 8)
+                if self._f.tell() >= self.end:
+                    return           # torn tail: nothing real follows
+                self._skip_corrupt(at, "bad record length",
+                                   resync=False)
+                continue
             self._f.read(_pad8(ln))
+            if failpoints.fire("record.decode"):
+                # injected decode fault: frame intact, payload declared
+                # rotten; the position already sits at the next record
+                self._skip_corrupt(at, "injected decode fault",
+                                   resync=False)
+                continue
             yield payload
 
     def reset(self) -> None:
         self._resync(self.begin)
+        self.skipped = 0
 
     def close(self) -> None:
         self._f.close()

@@ -9,6 +9,14 @@ seam. Local paths keep using plain ``open`` (no fsspec import cost).
 
 Used by: recordio readers/writers, BinaryPage packs, the mnist idx
 reader, config files, and checkpoint save/load/auto-resume.
+
+Resilience: every remote operation retries with exponential backoff +
+jitter (resilience.retry — one transient object-store 503 must not
+abort a training run), and the ``io.open`` / ``io.read`` / ``io.write``
+failpoints inject deterministic faults for chaos tests. When any
+``io.*`` failpoint is armed, LOCAL operations route through the same
+retry/wrapper path so the failure machinery is testable without an
+object store.
 """
 
 from __future__ import annotations
@@ -16,9 +24,60 @@ from __future__ import annotations
 import gzip
 import os
 import re
-from typing import List, Optional
+from typing import Callable, List, Optional
+
+from ..config import RetryPolicy
+from ..resilience import failpoints, retry_call
 
 _SCHEME_RE = re.compile(r"^[a-zA-Z][a-zA-Z0-9+.\-]*://")
+
+# module-level retry policy: main.py overrides from the io_retry_* config
+# keys; library users call set_retry_policy directly
+_RETRY = RetryPolicy()
+
+
+def set_retry_policy(policy: RetryPolicy) -> None:
+    global _RETRY
+    _RETRY = policy
+
+
+def get_retry_policy() -> RetryPolicy:
+    return _RETRY
+
+
+def _with_retry(fn: Callable, what: str, path: str):
+    """Retry remote ops (and local ops while io.* failpoints are armed —
+    chaos tests need the retry path without an object store); plain
+    local ops run bare, zero overhead."""
+    if is_remote(path) or failpoints.armed_prefix("io."):
+        return retry_call(fn, what=what, attempts=_RETRY.attempts,
+                          base_delay_s=_RETRY.base_delay_s,
+                          max_delay_s=_RETRY.max_delay_s,
+                          jitter=_RETRY.jitter)
+    return fn()
+
+
+class _FailpointFile:
+    """read()-path proxy consulted only while ``io.read`` is armed."""
+
+    def __init__(self, f):
+        self._f = f
+
+    def read(self, *a):
+        failpoints.check("io.read", IOError)
+        return self._f.read(*a)
+
+    def __getattr__(self, name):
+        return getattr(self._f, name)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._f.close()
+
+    def __iter__(self):
+        return iter(self._f)
 
 
 def is_remote(path: str) -> bool:
@@ -30,12 +89,35 @@ def _fs(path: str):
     return fsspec.core.url_to_fs(path)
 
 
-def sopen(path: str, mode: str = "rb"):
-    """Open a local path or a remote URL as a file object."""
+def _open_raw(path: str, mode: str):
+    """One open attempt, no retry — the primitive both sopen and the
+    composite retried operations build on (wrapping sopen itself inside
+    another _with_retry would multiply the configured attempts)."""
+    failpoints.check("io.open", IOError)
     if is_remote(path):
         import fsspec
         return fsspec.open(path, mode).open()
     return open(path, mode)
+
+
+def sopen(path: str, mode: str = "rb"):
+    """Open a local path or a remote URL as a file object."""
+    f = _with_retry(lambda: _open_raw(path, mode), f"open {path}", path)
+    if "r" in mode and failpoints.armed("io.read"):
+        return _FailpointFile(f)
+    return f
+
+
+def read_bytes(path: str) -> bytes:
+    """Whole-object read with ONE retry loop around the open+read pair:
+    a read that dies mid-stream cannot be resumed transparently, but
+    re-reading the object can — this is what checkpoint loads use for
+    remote (or failpoint-armed) paths."""
+    def _read():
+        with _open_raw(path, "rb") as f:
+            failpoints.check("io.read", IOError)
+            return f.read()
+    return _with_retry(_read, f"read {path}", path)
 
 
 def open_maybe_gz(path: str):
@@ -48,21 +130,21 @@ def open_maybe_gz(path: str):
 def getsize(path: str) -> int:
     if is_remote(path):
         fs, key = _fs(path)
-        return fs.size(key)
+        return _with_retry(lambda: fs.size(key), f"size {path}", path)
     return os.path.getsize(path)
 
 
 def exists(path: str) -> bool:
     if is_remote(path):
         fs, key = _fs(path)
-        return fs.exists(key)
+        return _with_retry(lambda: fs.exists(key), f"exists {path}", path)
     return os.path.exists(path)
 
 
 def isdir(path: str) -> bool:
     if is_remote(path):
         fs, key = _fs(path)
-        return fs.isdir(key)
+        return _with_retry(lambda: fs.isdir(key), f"isdir {path}", path)
     return os.path.isdir(path)
 
 
@@ -70,7 +152,8 @@ def listdir(path: str) -> List[str]:
     """Basenames of a directory's entries."""
     if is_remote(path):
         fs, key = _fs(path)
-        names = fs.ls(key, detail=False)
+        names = _with_retry(lambda: fs.ls(key, detail=False),
+                            f"ls {path}", path)
         return [str(n).rstrip("/").rsplit("/", 1)[-1] for n in names]
     return os.listdir(path)
 
@@ -78,20 +161,65 @@ def listdir(path: str) -> List[str]:
 def makedirs(path: str) -> None:
     if is_remote(path):
         fs, key = _fs(path)
-        fs.makedirs(key, exist_ok=True)
+        _with_retry(lambda: fs.makedirs(key, exist_ok=True),
+                    f"makedirs {path}", path)
     else:
         os.makedirs(path, exist_ok=True)
 
 
-def write_bytes_atomic(path: str, data: bytes) -> None:
-    """Atomic-where-possible write: local files go through tmp+rename so a
-    crash never leaves a torn checkpoint; object stores are already
-    all-or-nothing per PUT, so remote URLs write directly."""
+def remove(path: str) -> None:
+    """Delete one file/object (checkpoint rotation, tmp-orphan sweep)."""
     if is_remote(path):
-        with sopen(path, "wb") as f:
-            f.write(data)
+        fs, key = _fs(path)
+        _with_retry(lambda: fs.rm(key), f"rm {path}", path)
+    else:
+        os.remove(path)
+
+
+def getmtime(path: str) -> float:
+    """Last-modified time as a unix timestamp (the tmp-orphan sweep's
+    age check). Raises OSError when the backend cannot answer."""
+    if is_remote(path):
+        fs, key = _fs(path)
+        mt = _with_retry(lambda: fs.modified(key), f"mtime {path}", path)
+        return mt.timestamp() if hasattr(mt, "timestamp") else float(mt)
+    return os.path.getmtime(path)
+
+
+def write_bytes_atomic(path: str, data: bytes) -> None:
+    """Atomic-where-possible write: local files go through tmp+fsync+
+    rename so a crash never leaves a torn OR silently-unsynced
+    checkpoint; object stores are already all-or-nothing per PUT, so
+    remote URLs write directly (with retry)."""
+    if is_remote(path):
+        def _put():
+            with _open_raw(path, "wb") as f:
+                failpoints.check("io.write", IOError)
+                f.write(data)
+        _with_retry(_put, f"write {path}", path)
         return
-    tmp = path + ".tmp"
+    # pid-unique tmp name: two writers racing the same target (multi-host
+    # misconfig, or a retried save overlapping a stuck one) must not
+    # clobber each other's tmp mid-write; each renames its own file and
+    # os.replace keeps the LAST completed write
+    tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "wb") as f:
         f.write(data)
+        # flush + fsync BEFORE the rename: os.replace orders the name
+        # change, not the data — after a power cut an unfsynced rename
+        # can surface as the new name holding truncated bytes
+        f.flush()
+        os.fsync(f.fileno())
+    # the crash window the resume sweep exists for: a writer dying here
+    # leaves a *.tmp.<pid> orphan beside intact older checkpoints
+    failpoints.check("io.write", IOError)
     os.replace(tmp, path)
+    # fsync the directory so the rename itself is durable
+    try:
+        dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass          # non-POSIX dir handles (or exotic fs): best effort

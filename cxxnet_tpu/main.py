@@ -19,9 +19,11 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .config import ConfigPairs, parse_cli_overrides, parse_config_file
+from .config import (ConfigPairs, parse_cli_overrides, parse_config_file,
+                     parse_retry_policy)
 from .graph import global_param
 from .io.data import DataBatch, create_iterator
+from .resilience import SentinelAbort, TrainingSentinel, counters, failpoints
 from .trainer import Trainer
 from . import checkpoint as ckpt
 
@@ -111,6 +113,35 @@ class LearnTask:
         # (view with xprof/tensorboard); the reference prescribed external
         # tools only (doc/debug_perf.md) — built-in here
         self.profile_dir = gp("profile_dir", "")
+        # -- resilience (doc/tasks.md "Fault tolerance") ------------------
+        # fault injection: failpoints = "site=mode,..." config key plus
+        # the CXXNET_FAILPOINTS env var (env wins on clashes)
+        failpoints.install(gp("failpoints", ""), env=True)
+        # transient-IO retry knobs for every remote stream op
+        from .io import stream
+        stream.set_retry_policy(parse_retry_policy(self.global_cfg))
+        # checkpoint hygiene: keep only the newest N (0 = keep all)
+        self.keep_last_n = int(gp("keep_last_n", "0"))
+        # loss sentinel: NaN/Inf detection is on by default (sentinel=0
+        # disables); spikes trip at sentinel_spike_factor x rolling
+        # median (0 disables spike detection only). Every anomaly rolls
+        # back to the last VALID checkpoint with the LR scaled by
+        # lr_backoff; past max_rollbacks the run aborts with a report.
+        self.sentinel_on = int(gp("sentinel", "1"))
+        self.sentinel_spike_factor = float(gp("sentinel_spike_factor", "10"))
+        self.sentinel_window = int(gp("sentinel_window", "50"))
+        self.sentinel_min_history = int(gp("sentinel_min_history", "8"))
+        self.max_rollbacks = int(gp("max_rollbacks", "3"))
+        self.lr_backoff = float(gp("lr_backoff", "0.5"))
+        # check cadence: reading the loss syncs the host to the device
+        # step, so a per-step check would serialize the dispatch overlap
+        # the prefetch pipeline exists for. Default 8 amortizes the sync
+        # to 1-in-8 steps; NaN poisons every subsequent loss (the params
+        # carry it), so detection lands <8 steps late and the rollback
+        # absorbs the difference. Set 1 for per-step fidelity (catches
+        # one-step transient spikes too).
+        self.sentinel_interval = max(1, int(gp("sentinel_interval", "8")))
+        self.sentinel: Optional[TrainingSentinel] = None
         # dev=cpu must be pinned BEFORE the first device query
         # (jax.process_index below): a remote-attached accelerator plugin
         # (axon tunnel) initializes eagerly on that query and a dead link
@@ -157,13 +188,21 @@ class LearnTask:
                 return self._make_iter(pairs)
         return None
 
-    def _agree_latest(self):
+    def _agree_latest(self, want_blob: bool = False):
         """Resolve the continue=1 resume round, and in multi-host runs verify
         every rank resolved the SAME round before anyone loads — ranks that
         scan model_dir independently on non-shared disks would otherwise
         issue mismatched collectives and hang. model_dir must live on a
-        filesystem visible to all ranks (doc/multichip.md)."""
-        latest = ckpt.find_latest(self.model_dir)
+        filesystem visible to all ranks (doc/multichip.md).
+
+        The scan is find_latest_valid: a checkpoint truncated by a killed
+        run is SKIPPED (with its ``.tmp`` orphans swept) and resume falls
+        back to the newest round that verifies — crash consistency, not
+        just crash detection. ``want_blob`` forwards the verified blob so
+        the caller restores without a second archive read."""
+        latest = ckpt.find_latest_valid(self.model_dir,
+                                        verbose=not self.silent,
+                                        want_blob=want_blob)
         import jax
         if jax.process_count() > 1:
             import numpy as np
@@ -183,11 +222,13 @@ class LearnTask:
     def _init_model(self) -> None:
         tr = self.trainer
         if self.continue_training:
-            latest = self._agree_latest()
+            latest = self._agree_latest(want_blob=True)
             if latest is not None:
-                r, path = latest
+                # restore from the blob the verification scan already
+                # read — no second archive read/hash on resume
+                r, path, blob = latest
                 tr.init_model()
-                tr.load_model(path)
+                tr.load_blob(blob)
                 self.start_counter = r + 1
                 if not self.silent:
                     print(f"continuing from round {r} ({path})")
@@ -243,6 +284,16 @@ class LearnTask:
                     print(f"profiler trace written to {self.profile_dir}")
         if self.save_model and not self.test_io:
             from .io import stream
+            # drain any pending async PERIODIC write tolerantly first —
+            # its failure is covered by the degrade-don't-die contract
+            # and must not abort before the final model is attempted
+            try:
+                tr.wait_saves()
+            except RuntimeError as e:
+                counters.inc("ckpt.write_failures")
+                if self._is_root:
+                    print(f"WARNING: async checkpoint write failed: {e}; "
+                          "attempting the final save anyway", flush=True)
             # the last round actually RUN (max_round may cap below
             # num_round)
             final = ckpt.model_path(
@@ -250,7 +301,104 @@ class LearnTask:
                 getattr(self, "_end_round", self.num_round) - 1)
             if not stream.exists(final):
                 tr.save_model(final)
-        tr.wait_saves()       # drain async checkpoint writes before exit
+        # the FINAL model's write failure still raises — exiting 0
+        # without the artifact the run exists to produce would be a lie
+        tr.wait_saves()
+
+    # -- resilience hooks --------------------------------------------------
+    def _sentinel_step(self, tr, r: int, losses=None,
+                       force: bool = False) -> None:
+        """Feed the sentinel after a dispatched update; on an anomaly,
+        roll back to the newest VALID checkpoint, back off the LR, and
+        relabel the trainer to the current round so checkpoint naming
+        stays monotonic. Raises :class:`SentinelAbort` when there is
+        nothing valid to roll back to or the rollback budget is spent.
+        The ``sentinel_interval`` gate amortizes the host-device sync
+        for plain AND chain dispatches; ``force=True`` (end of round,
+        just before the checkpoint write) bypasses it so a NaN that
+        landed between ticks can never be checkpointed."""
+        sentinel = self.sentinel
+        if sentinel is None:
+            return
+        self._sentinel_tick += 1
+        if not force and self._sentinel_tick % self.sentinel_interval:
+            return
+        if losses is None:
+            vals = [tr.last_loss]
+        else:          # chain dispatch: the per-step loss vector, host-side
+            vals = [float(v) for v in np.asarray(losses).ravel()]
+        reason = None
+        for v in vals:
+            reason = sentinel.observe(v)
+            if reason:
+                break
+        if reason is None:
+            return
+        # drain any in-flight async checkpoint write BEFORE scanning —
+        # a failed one degrades (counted) exactly like a sync failure,
+        # and the scan must not race a live writer. No tmp sweep here:
+        # sweeping belongs to the resume path, where no writer can be
+        # live; mid-run the orphans are inert and a sweep could eat a
+        # concurrent rank's tmp on a shared filesystem.
+        try:
+            tr.wait_saves()
+        except RuntimeError as e:
+            counters.inc("ckpt.write_failures")
+            if self._is_root:
+                print(f"WARNING: async checkpoint write failed: {e}; "
+                      "rolling back to an older checkpoint", flush=True)
+        latest = ckpt.find_latest_valid(self.model_dir, sweep_tmp=False,
+                                        want_blob=True)
+        if latest is None:
+            raise SentinelAbort(
+                f"training anomaly with no valid checkpoint to roll back "
+                f"to: {reason}\n{sentinel.report()}")
+        sentinel.record_rollback(latest[0], reason)   # aborts past budget
+        r0, path, blob = latest
+        # the blob was just read+verified by the scan — restore from it
+        # directly (no second archive read). load_blob resets lr_scale
+        # to the checkpoint's saved value, so back off from the LOWER of
+        # (pre-rollback, checkpoint) scale — repeated rollbacks onto the
+        # same checkpoint still compound the backoff.
+        scale_before = tr.optimizer.lr_scale
+        tr.rollback(path, blob=blob)
+        tr.start_round(r)      # keep %04d naming monotonic after restore
+        tr.optimizer.lr_scale = min(scale_before, tr.optimizer.lr_scale) \
+            * self.lr_backoff
+        sentinel.reset_window()
+        counters.inc("sentinel.rollbacks")
+        if not self.silent:
+            print(f"sentinel: {reason}; rolled back to round {r0} "
+                  f"checkpoint ({path}), lr_scale="
+                  f"{tr.optimizer.lr_scale:g}", flush=True)
+
+    def _save_round(self, tr, r: int) -> None:
+        """Periodic checkpoint write, degradation-tolerant: a failed
+        write logs and counts but never kills the run (the next period
+        retries; resume simply falls back one more round), then rotation
+        trims beyond keep_last_n."""
+        # never persist poisoned weights: a step whose apply NaN'd the
+        # params AFTER its (finite) loss was computed would otherwise
+        # produce a digest-valid NaN checkpoint that every subsequent
+        # rollback faithfully restores
+        if self.sentinel is not None and not tr.params_finite():
+            counters.inc("ckpt.skipped_poisoned")
+            if self._is_root:
+                print(f"WARNING: skipping checkpoint for round {r}: "
+                      "params are non-finite (sentinel will roll back)",
+                      flush=True)
+            return
+        try:
+            tr.save_model(ckpt.model_path(self.model_dir, r))
+        except Exception as e:
+            counters.inc("ckpt.write_failures")
+            if self._is_root:
+                print(f"WARNING: checkpoint write failed for round {r}: "
+                      f"{type(e).__name__}: {e}; training continues "
+                      "(next save period retries)", flush=True)
+            return
+        if self.keep_last_n:
+            ckpt.rotate_checkpoints(self.model_dir, self.keep_last_n)
 
     def _train_rounds(self, tr, itr_train, evals) -> None:
         start = time.time()
@@ -258,6 +406,16 @@ class LearnTask:
         if self.max_round > 0:
             end_round = min(end_round, self.start_counter + self.max_round)
         self._end_round = end_round
+        self._sentinel_tick = 0
+        if self.sentinel_on and not self.test_io:
+            if not 0.0 < self.lr_backoff <= 1.0:
+                raise ValueError(
+                    f"lr_backoff must be in (0, 1], got {self.lr_backoff}")
+            self.sentinel = TrainingSentinel(
+                spike_factor=self.sentinel_spike_factor,
+                window=self.sentinel_window,
+                min_history=self.sentinel_min_history,
+                max_rollbacks=self.max_rollbacks)
         chain = self.train_chain if self.train_chain > 1 else 0
         if chain and (tr.mesh.pipeline_parallel > 1
                       or (tr.update_period > 1
@@ -301,14 +459,16 @@ class LearnTask:
                     # progress accounting covers DISPATCHED work only —
                     # queued-but-untrained batches must not inflate
                     # images/sec or read a stale/absent loss
-                    tr.update_chain_batches(pending)
+                    losses = tr.update_chain_batches(pending)
                     batch_count += len(pending)
                     n_images += pending_rows
                     pending, pending_rows = [], 0
+                    self._sentinel_step(tr, r, losses=losses)
                 else:
                     tr.update(batch)
                     n_images += real_rows
                     batch_count += 1
+                    self._sentinel_step(tr, r)
                 if self.print_step \
                         and batch_count // self.print_step \
                         != (batch_count - (chain or 1)) // self.print_step \
@@ -322,6 +482,7 @@ class LearnTask:
                 tr.update(b)
                 n_images += b.batch_size - b.num_batch_padd
                 batch_count += 1
+                self._sentinel_step(tr, r)
             if self.test_io:
                 dt = max(time.time() - round_start, 1e-9)
                 print(f"round {r:8d}: test_io {n_images} images in "
@@ -341,7 +502,11 @@ class LearnTask:
             # (reference cxxnet_main.cpp:220)
             if self.save_model and self.save_period \
                     and (r + 1) % self.save_period == 0:
-                tr.save_model(ckpt.model_path(self.model_dir, r))
+                # forced (interval-independent) sentinel check first: a
+                # NaN that landed between ticks must trigger the
+                # rollback BEFORE this round is checkpointed
+                self._sentinel_step(tr, r, force=True)
+                self._save_round(tr, r)
 
     def task_serve(self) -> None:
         """Online inference endpoint (serve/): the request-driven analog
@@ -355,14 +520,17 @@ class LearnTask:
         # state (momentum buffers ~double device bytes; an engine never
         # steps the optimizer) — NOT the training path's _init_model
         model_path = None
+        verified = False
         if self.continue_training:
             latest = self._agree_latest()
             if latest is not None:
                 model_path = latest[1]
+                verified = True      # find_latest_valid just verified it
         if model_path is None and self.model_in != "NULL":
             model_path = self.model_in
         if model_path is not None:
-            restore_inference_state(self.trainer, model_path)
+            restore_inference_state(self.trainer, model_path,
+                                    verify=not verified)
             if not self.silent:
                 print(f"serving model {model_path}", flush=True)
         else:
@@ -388,6 +556,12 @@ class LearnTask:
             max_queue_rows=int(gp("serve_queue_rows", "1024")),
             default_timeout_ms=float(gp("serve_timeout_ms", "0")) or None,
             log_interval_s=float(gp("serve_log_interval", "30")),
+            # circuit breaker: N consecutive dispatch failures -> fail-fast
+            # 503s until a half-open probe succeeds (0 disables)
+            breaker_threshold=int(gp("serve_breaker_threshold", "5")),
+            breaker_reset_s=float(gp("serve_breaker_reset_s", "10")),
+            degraded_queue_frac=float(gp("serve_degraded_queue_frac",
+                                         "0.8")),
             silent=bool(self.silent))
         srv.start()
         srv.serve_until_interrupt()

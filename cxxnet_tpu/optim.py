@@ -198,6 +198,11 @@ class Optimizer:
         self.ls_min = float(global_param(cfg, "loss_scale_min", "1.0"))
         self.ls_max = float(global_param(cfg, "loss_scale_max",
                                          str(2.0 ** 24)))
+        # sentinel LR-backoff hook: multiplies every tag's scheduled lr
+        # (main.py halves it per rollback via the lr_backoff knob); the
+        # trainer's schedule caches key on VALUES so a change propagates
+        # without recompiling the step
+        self.lr_scale = 1.0
 
     # -- state -------------------------------------------------------------
     def _mp_init(self) -> Dict[str, jax.Array]:
@@ -245,7 +250,11 @@ class Optimizer:
 
     def schedules(self, epoch: int) -> Dict[str, Tuple[float, float]]:
         """Host-side schedule evaluation; pass the result into update()."""
-        return {tag: h.schedule(epoch) for tag, h in self.hypers.items()}
+        out = {}
+        for tag, h in self.hypers.items():
+            lr, mom = h.schedule(epoch)
+            out[tag] = (lr * self.lr_scale, mom)
+        return out
 
     # -- update ------------------------------------------------------------
     def update(self, params, grads, opt_state, sched: Dict[str, Any],

@@ -1,0 +1,62 @@
+"""Fault tolerance for training and serving (the layer scaling leans on).
+
+Four pieces, each usable alone:
+
+* :mod:`.failpoints` — named, env/config-driven fault-injection sites
+  (``CXXNET_FAILPOINTS="ckpt.write=once,io.read=0.01"``) so every
+  failure path is deterministically testable;
+* :mod:`.retry` — exponential-backoff-with-jitter ``retry_call`` used by
+  io/stream.py for remote operations;
+* :mod:`.sentinel` — :class:`TrainingSentinel`, the loss NaN/spike
+  watchdog driving checkpoint rollback + LR backoff in the round loop;
+* :mod:`.breaker` — :class:`CircuitBreaker` for the serve dispatch path
+  (fail-fast 503s with a half-open recovery probe).
+
+Plus a tiny process-wide ``counters`` registry (below) that ties them
+together for observability: recordio corruption skips, IO retries,
+checkpoint write failures and invalid-checkpoint skips all land here and
+surface through ``/healthz`` / ``/statz`` and the chaos smoke tool.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+
+class Counters:
+    """Thread-safe named counters (process-wide degradation ledger)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._c: Dict[str, int] = {}
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._c[name] = self._c.get(name, 0) + n
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._c.get(name, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._c)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._c.clear()
+
+
+counters = Counters()
+
+from . import failpoints                                    # noqa: E402
+from .failpoints import InjectedFault                       # noqa: E402
+from .retry import retry_call                               # noqa: E402
+from .sentinel import SentinelAbort, TrainingSentinel       # noqa: E402
+from .breaker import CircuitBreaker, CircuitOpen            # noqa: E402
+
+__all__ = [
+    "counters", "failpoints", "InjectedFault", "retry_call",
+    "SentinelAbort", "TrainingSentinel", "CircuitBreaker", "CircuitOpen",
+]

@@ -1,0 +1,137 @@
+"""Circuit breaker for the serve dispatch path.
+
+A wedged device (driver hang, OOM loop, poisoned executable) turns every
+queued request into a slow failure: clients wait out the full batching
+window plus the device timeout just to get a 500. The breaker converts
+that into fail-fast 503s — the standard closed/open/half-open state
+machine:
+
+* **closed**   — normal operation; ``failure_threshold`` CONSECUTIVE
+  dispatch failures trip it open (one success resets the streak);
+* **open**     — every request is rejected immediately (HTTP 503,
+  ``Retry-After``-style semantics) until ``reset_timeout_s`` elapses;
+* **half_open** — one probe request is let through; success closes the
+  breaker, failure re-opens it (and restarts the timeout).
+
+``allow()`` gates admissions (serve/batcher.py submit), ``record_*``
+observe dispatch outcomes (serve/batcher.py _dispatch). All transitions
+are lock-protected; the clock is injectable for deterministic tests.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict
+
+
+class CircuitOpen(RuntimeError):
+    """Breaker is open: fail fast, retry later (HTTP 503)."""
+
+
+class CircuitBreaker:
+    def __init__(self, failure_threshold: int = 5,
+                 reset_timeout_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic):
+        if failure_threshold < 1:
+            raise ValueError(
+                f"failure_threshold must be >= 1, got {failure_threshold}")
+        self.failure_threshold = int(failure_threshold)
+        self.reset_timeout_s = float(reset_timeout_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = "closed"              # closed | open | half_open
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probe_at = 0.0
+        # lifetime counters (served raw through /statz)
+        self.opens = 0
+        self.probes = 0
+        self.rejections = 0
+
+    @property
+    def state(self) -> str:
+        """Raw state; does not consume the probe slot. An expired open
+        period still reads "open" — use :meth:`effective_state` for
+        health reporting."""
+        with self._lock:
+            return self._state
+
+    def effective_state(self) -> str:
+        """State as a health endpoint should report it: an open breaker
+        PAST its reset timeout reads "half_open" (probe-ready), so a
+        load balancer that drains on "open" resumes sending the trickle
+        of traffic recovery depends on — without this, zero traffic
+        means zero allow() calls and the node stays 503 forever."""
+        with self._lock:
+            if self._state == "open" \
+                    and self._clock() - self._opened_at \
+                    >= self.reset_timeout_s:
+                return "half_open"
+            return self._state
+
+    # -- admission gate --------------------------------------------------
+    def allow(self) -> bool:
+        """May a new request be admitted right now? An open breaker past
+        its reset timeout admits exactly ONE request (the half-open
+        probe); everything else waits for the probe's verdict. A probe
+        that never reports back (rejected by a later gate, expired at
+        flush time, client gone) must not wedge the breaker: after
+        another reset period with no verdict, a fresh probe is armed."""
+        with self._lock:
+            if self._state == "closed":
+                return True
+            now = self._clock()
+            if self._state == "open":
+                if now - self._opened_at >= self.reset_timeout_s:
+                    self._state = "half_open"
+                    self._probe_at = now
+                    self.probes += 1
+                    return True
+                self.rejections += 1
+                return False
+            # half_open: a probe is in flight — unless it vanished
+            # without a verdict for a full reset period, in which case
+            # arm a replacement probe
+            if now - self._probe_at >= self.reset_timeout_s:
+                self._probe_at = now
+                self.probes += 1
+                return True
+            self.rejections += 1
+            return False
+
+    # -- outcome observation ---------------------------------------------
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive_failures = 0
+            self._state = "closed"
+
+    def record_failure(self) -> None:
+        with self._lock:
+            if self._state == "half_open":
+                # the probe failed: straight back to open, timer restarts
+                self._trip()
+                return
+            self._consecutive_failures += 1
+            if self._consecutive_failures >= self.failure_threshold:
+                self._trip()
+
+    def _trip(self) -> None:
+        if self._state != "open":
+            self.opens += 1
+        self._state = "open"
+        self._opened_at = self._clock()
+        self._consecutive_failures = 0
+
+    # -- introspection ---------------------------------------------------
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "state": self._state,
+                "failure_threshold": self.failure_threshold,
+                "reset_timeout_s": self.reset_timeout_s,
+                "consecutive_failures": self._consecutive_failures,
+                "opens": self.opens,
+                "probes": self.probes,
+                "rejections": self.rejections,
+            }

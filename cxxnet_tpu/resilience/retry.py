@@ -1,0 +1,56 @@
+"""Exponential-backoff-with-jitter retry for transient IO failures.
+
+The reference framework rode dmlc-Stream, whose HDFS/S3 clients retried
+internally; fsspec's raw ``gs://`` reads do not, so one transient 503
+from an object store would abort a multi-hour training run at the
+checkpoint read. ``retry_call`` wraps any thunk in the standard
+full-jitter exponential backoff (AWS architecture-blog recipe): attempt
+``i`` sleeps ``uniform(0, min(max_delay, base * 2**i))`` — the jitter
+decorrelates a gang of workers hammering the same recovering endpoint.
+
+Used by io/stream.py for every remote (and failpoint-armed) operation;
+knobs arrive as a :class:`cxxnet_tpu.config.RetryPolicy`
+(``io_retry_attempts`` / ``io_retry_base_ms`` / ``io_retry_max_ms`` /
+``io_retry_jitter`` config keys).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Optional, Tuple, Type
+
+from . import counters
+
+
+def retry_call(fn: Callable, *, what: str = "",
+               attempts: int = 4,
+               base_delay_s: float = 0.05,
+               max_delay_s: float = 2.0,
+               jitter: float = 1.0,
+               retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+               sleep: Callable[[float], None] = time.sleep,
+               rng: Callable[[], float] = random.random,
+               on_retry: Optional[Callable] = None):
+    """Call ``fn()`` with up to ``attempts`` tries.
+
+    ``jitter`` in [0, 1]: 0 = deterministic full backoff, 1 = full
+    jitter (delay uniform in [0, cap]). ``sleep``/``rng`` are injectable
+    so tests run instantly and deterministically. ``on_retry(i, exc,
+    delay)`` observes each retry. The final failure re-raises the last
+    exception unchanged."""
+    if attempts < 1:
+        raise ValueError(f"retry attempts must be >= 1, got {attempts}")
+    for i in range(attempts):
+        try:
+            return fn()
+        except retry_on as e:
+            if i == attempts - 1:
+                raise
+            cap = min(max_delay_s, base_delay_s * (2.0 ** i))
+            delay = cap * (1.0 - jitter + jitter * rng())
+            counters.inc("io.retries")
+            if on_retry is not None:
+                on_retry(i, e, delay)
+            sleep(delay)
+    raise AssertionError("unreachable")

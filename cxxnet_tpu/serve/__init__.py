@@ -10,17 +10,21 @@ philosophy to request-driven prediction:
   bucket, steady-state traffic never recompiles);
 * :mod:`.batcher` — ``MicroBatcher``: dynamic micro-batching queue that
   amortizes per-call dispatch overhead into device batches, with
-  backpressure and per-request deadlines;
+  backpressure, per-request deadlines, and an optional circuit breaker
+  (``resilience.CircuitBreaker`` — fail-fast 503s when the device is
+  wedged, half-open probe recovery);
 * :mod:`.stats`   — ``ServingStats``: rolling QPS, latency percentiles,
   batch-fill ratio, compile-cache hit/miss accounting;
 * :mod:`.server`  — stdlib ``http.server`` JSON front-end
   (``/predict``, ``/extract``, ``/healthz``, ``/statz``).
 """
 
+from ..resilience import CircuitBreaker, CircuitOpen
 from .engine import InferenceEngine
 from .batcher import MicroBatcher, Backpressure, DeadlineExceeded
 from .stats import ServingStats
 from .server import ServeServer
 
 __all__ = ["InferenceEngine", "MicroBatcher", "Backpressure",
-           "DeadlineExceeded", "ServingStats", "ServeServer"]
+           "DeadlineExceeded", "ServingStats", "ServeServer",
+           "CircuitBreaker", "CircuitOpen"]

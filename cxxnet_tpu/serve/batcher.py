@@ -10,7 +10,13 @@ ThreadBufferIterator (io/proc.py) — with:
   :class:`Backpressure` instead of queueing unboundedly;
 * **deadlines**: each request may carry ``timeout_ms``; requests whose
   deadline passed by dispatch time are rejected with
-  :class:`DeadlineExceeded` rather than served stale.
+  :class:`DeadlineExceeded` rather than served stale;
+* **circuit breaking**: an optional :class:`resilience.CircuitBreaker`
+  — N consecutive dispatch failures (a wedged/poisoned device) flip it
+  open and ``submit`` fails fast with :class:`CircuitOpen` (HTTP 503)
+  instead of letting every client wait out the full batching window
+  just to collect a 500; after the reset timeout one half-open probe
+  request is admitted and its outcome closes or re-opens the breaker.
 
 Requests of different output kinds (predict / raw / extract[node])
 cannot share a device call, so pending work is grouped per
@@ -27,6 +33,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..resilience import CircuitBreaker, CircuitOpen
 from .engine import InferenceEngine
 from .stats import ServingStats
 
@@ -57,9 +64,11 @@ class MicroBatcher:
                  max_latency_ms: float = 5.0,
                  max_queue_rows: int = 1024,
                  default_timeout_ms: Optional[float] = None,
-                 stats: Optional[ServingStats] = None):
+                 stats: Optional[ServingStats] = None,
+                 breaker: Optional[CircuitBreaker] = None):
         self.engine = engine
         self.stats = stats or engine.stats
+        self.breaker = breaker
         # clamped to the engine's largest bucket: a dispatch bigger than
         # the bucket ceiling could never run as one device call
         self.max_batch = min(int(max_batch or engine.max_batch),
@@ -92,6 +101,14 @@ class MicroBatcher:
                 f"{self.max_batch}; split client-side or call the engine "
                 "directly")
         self.stats.record_request()
+        # breaker gate AFTER input validation (malformed requests are the
+        # client's fault, not the device's) and BEFORE queueing: an open
+        # breaker must answer in microseconds, not a batching window
+        if self.breaker is not None and not self.breaker.allow():
+            self.stats.record_reject("breaker")
+            raise CircuitOpen(
+                f"serve circuit breaker open ({self.breaker.state}); "
+                "device dispatches are failing — retry later")
         if timeout_ms is None:
             timeout_ms = self.default_timeout_ms
         deadline = (time.perf_counter() + timeout_ms / 1e3
@@ -121,6 +138,13 @@ class MicroBatcher:
             self._stop.set()
         self._q.put(None)                 # wake the worker
         self._thread.join(timeout=timeout)
+
+    @property
+    def queued_rows(self) -> int:
+        """Rows currently admitted but not yet dispatched (the /healthz
+        queue-saturation signal)."""
+        with self._rows_lock:
+            return self._queued_rows
 
     # -- worker side -----------------------------------------------------
     def _release(self, reqs: List[_Request]) -> None:
@@ -161,10 +185,14 @@ class MicroBatcher:
         try:
             out = self.engine.run_padded(rows, live[0].kind, live[0].node)
         except Exception as e:
+            if self.breaker is not None:
+                self.breaker.record_failure()
             for r in live:
                 self.stats.record_failure()
                 r.future.set_exception(e)
             return
+        if self.breaker is not None:
+            self.breaker.record_success()
         self.stats.record_batch(
             n_requests=len(live), rows_real=rows.shape[0],
             rows_bucket=self.engine.bucket_for(rows.shape[0]))

@@ -27,6 +27,7 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 import numpy as np
 
 from ..config import ConfigPairs, parse_config_string, parse_policy
+from ..resilience import failpoints
 from ..trainer import Trainer
 from .. import checkpoint as ckpt
 from .stats import ServingStats
@@ -35,13 +36,15 @@ from .stats import ServingStats
 _KINDS = ("predict", "raw", "extract")
 
 
-def restore_inference_state(trainer: Trainer, model_path: str) -> None:
+def restore_inference_state(trainer: Trainer, model_path: str,
+                            verify: bool = True) -> None:
     """Restore params + layer state onto ``trainer`` from a checkpoint
     WITHOUT materializing optimizer state (momentum buffers would roughly
     double the model's device bytes, and an engine never steps the
     optimizer) — shared by InferenceEngine.from_checkpoint and the
-    ``task = serve`` driver branch."""
-    blob = ckpt.load_for_inference(model_path)
+    ``task = serve`` driver branch. ``verify=False`` when the caller
+    just verified the archive (the continue=1 resume scan)."""
+    blob = ckpt.load_for_inference(model_path, verify=verify)
     ckpt.check_structure(blob["meta"],
                          trainer.graph.structure_signature())
     trainer.params, trainer.net_state = trainer._place(
@@ -252,6 +255,9 @@ class InferenceEngine:
         per invocation."""
         if kind not in _KINDS:
             raise ValueError(f"unknown output kind {kind!r}")
+        # the wedged-device stand-in chaos tests use to trip the serve
+        # circuit breaker (batcher counts consecutive dispatch failures)
+        failpoints.check("serve.infer", RuntimeError)
         n = rows_nhwc.shape[0]
         bucket = self.bucket_for(n)
         if n > bucket:

@@ -7,12 +7,25 @@ dependencies (the same reason the IO pipeline is pure stdlib threading):
   "timeout_ms": N?}`` -> ``{"pred": [...]}`` / ``{"prob": [[...]]}``
 * ``POST /extract``  ``{"data": ..., "node": "name"}``
   -> ``{"features": [[...]]}``
-* ``GET  /healthz``  -> ``{"ok": true}``
-* ``GET  /statz``    -> the ServingStats snapshot dict
+* ``GET  /healthz``  -> ``{"status": "ok"|"degraded"|"open"|"down", ...}``
+* ``GET  /statz``    -> the ServingStats snapshot + breaker/queue state
 
-Error mapping: malformed request 400, backpressure 503 (retry later),
-deadline exceeded 504, engine failure 500. Shutdown is graceful: stop
-accepting, then drain the batcher so queued requests still get answers.
+Health semantics (what a load balancer keys routing on):
+
+* ``ok``       (200) — dispatching normally;
+* ``degraded`` (200) — still serving but impaired: the admitted-row
+  queue is past ``degraded_queue_frac`` of its budget, the breaker is
+  half-open (probing a recovering device), or corrupt input records
+  have been skipped this process (``recordio.skipped``) — keep
+  routing, start paging;
+* ``open``     (503) — the circuit breaker is open: dispatches are
+  failing and requests are being rejected fast — route elsewhere;
+* ``down``     (500) — the batcher worker is dead.
+
+Error mapping: malformed request 400, backpressure AND breaker-open 503
+(retry later), deadline exceeded 504, engine failure 500. Shutdown is
+graceful: stop accepting, then drain the batcher so queued requests
+still get answers.
 """
 
 from __future__ import annotations
@@ -21,10 +34,11 @@ import json
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from ..resilience import CircuitBreaker, CircuitOpen, counters
 from .batcher import Backpressure, DeadlineExceeded, MicroBatcher
 from .engine import InferenceEngine
 from .stats import ServingStats
@@ -54,11 +68,10 @@ def _make_handler(server: "ServeServer"):
 
         def do_GET(self):
             if self.path == "/healthz":
-                ok = server.batcher is not None \
-                    and server.batcher._thread.is_alive()
-                self._reply(200 if ok else 500, {"ok": bool(ok)})
+                code, payload = server.health()
+                self._reply(code, payload)
             elif self.path == "/statz":
-                self._reply(200, server.stats.snapshot())
+                self._reply(200, server.statz())
             else:
                 self._reply(404, {"error": f"no such path {self.path}"})
 
@@ -94,7 +107,7 @@ def _make_handler(server: "ServeServer"):
                     out = fut.result(timeout=server.result_timeout_s)
                     key = "prob" if kind == "raw" else "pred"
                     self._reply(200, {key: out.tolist()})
-            except Backpressure as e:
+            except (Backpressure, CircuitOpen) as e:
                 self._reply(503, {"error": str(e)})
             except DeadlineExceeded as e:
                 self._reply(504, {"error": str(e)})
@@ -120,7 +133,10 @@ class ServeServer:
                  log_interval_s: float = 30.0,
                  silent: bool = False, verbose: bool = False,
                  max_body_bytes: int = 64 << 20,
-                 result_timeout_s: float = 120.0):
+                 result_timeout_s: float = 120.0,
+                 breaker_threshold: int = 5,
+                 breaker_reset_s: float = 10.0,
+                 degraded_queue_frac: float = 0.8):
         self.engine = engine
         self.stats: ServingStats = engine.stats
         self.silent = silent
@@ -128,16 +144,70 @@ class ServeServer:
         self.max_body_bytes = max_body_bytes
         self.result_timeout_s = result_timeout_s
         self.log_interval_s = log_interval_s
+        self.degraded_queue_frac = float(degraded_queue_frac)
+        # breaker_threshold = 0 disables circuit breaking entirely
+        self.breaker = (CircuitBreaker(failure_threshold=breaker_threshold,
+                                       reset_timeout_s=breaker_reset_s)
+                        if breaker_threshold > 0 else None)
+        # degradation is reported relative to THIS server's lifetime —
+        # corrupt records skipped before serving started (e.g. during
+        # training in the same process) are not this endpoint's problem
+        self._skipped_base = counters.get("recordio.skipped")
         self.batcher = MicroBatcher(
             engine, max_batch=max_batch, max_latency_ms=max_latency_ms,
             max_queue_rows=max_queue_rows,
-            default_timeout_ms=default_timeout_ms, stats=self.stats)
+            default_timeout_ms=default_timeout_ms, stats=self.stats,
+            breaker=self.breaker)
         self.httpd = ThreadingHTTPServer((host, port), _make_handler(self))
         self.httpd.daemon_threads = True
         self.port = self.httpd.server_address[1]
         self._http_thread: Optional[threading.Thread] = None
         self._log_stop = threading.Event()
         self._log_thread: Optional[threading.Thread] = None
+
+    # -- health ----------------------------------------------------------
+    def health(self) -> Tuple[int, Dict]:
+        """``ok | degraded | open | down`` + the signals behind the call
+        (see module docstring for the load-balancer semantics)."""
+        alive = self.batcher is not None \
+            and self.batcher._thread.is_alive()
+        queued = self.batcher.queued_rows if alive else 0
+        queue_frac = queued / max(1, self.batcher.max_queue_rows)
+        skipped = counters.get("recordio.skipped") - self._skipped_base
+        # effective_state: an open breaker past its reset timeout reads
+        # half_open (-> degraded, 200), so a load balancer that drained
+        # this node on 503 resumes the trickle of traffic the recovery
+        # probe needs — raw "open" would hold it out of rotation forever
+        breaker_state = (self.breaker.effective_state()
+                         if self.breaker is not None else "disabled")
+        if not alive:
+            status, code = "down", 500
+        elif breaker_state == "open":
+            status, code = "open", 503
+        elif (breaker_state == "half_open"
+              or queue_frac >= self.degraded_queue_frac
+              or skipped > 0):
+            status, code = "degraded", 200
+        else:
+            status, code = "ok", 200
+        return code, {
+            "status": status,
+            "ok": status == "ok",           # back-compat boolean
+            "breaker": breaker_state,
+            "queued_rows": queued,
+            "queue_frac": round(queue_frac, 4),
+            "skipped_records": skipped,
+        }
+
+    def statz(self) -> Dict:
+        """ServingStats snapshot + the resilience state alongside it."""
+        out = self.stats.snapshot()
+        if self.breaker is not None:
+            out["breaker"] = self.breaker.snapshot()
+        out["queue"] = {"rows": self.batcher.queued_rows,
+                        "max_rows": self.batcher.max_queue_rows}
+        out["counters"] = counters.snapshot()
+        return out
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "ServeServer":

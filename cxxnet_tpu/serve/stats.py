@@ -36,6 +36,7 @@ class ServingStats:
         self.requests_ok = 0
         self.rejected_backpressure = 0
         self.rejected_deadline = 0
+        self.rejected_breaker = 0
         self.failed = 0
         # batch counters
         self.batches_dispatched = 0
@@ -59,6 +60,8 @@ class ServingStats:
         with self._lock:
             if kind == "backpressure":
                 self.rejected_backpressure += 1
+            elif kind == "breaker":
+                self.rejected_breaker += 1
             else:
                 self.rejected_deadline += 1
 
@@ -120,15 +123,16 @@ class ServingStats:
             done_ts = list(self._done_ts)
             counters = (self.requests_total, self.requests_ok,
                         self.rejected_backpressure, self.rejected_deadline,
+                        self.rejected_breaker,
                         self.failed, self.batches_dispatched,
                         self.requests_batched, self.rows_real,
                         self.rows_padded, self.batches_coalesced_ge2,
                         self.cache_hits, self.cache_misses,
                         self.cache_evictions, self.cache_size,
                         self.cache_capacity)
-        (req_total, req_ok, rej_bp, rej_dl, failed, b_disp, req_batched,
-         rows_real, rows_padded, coalesced, c_hit, c_miss, c_evict,
-         c_size, c_cap) = counters
+        (req_total, req_ok, rej_bp, rej_dl, rej_br, failed, b_disp,
+         req_batched, rows_real, rows_padded, coalesced, c_hit, c_miss,
+         c_evict, c_size, c_cap) = counters
         uptime = max(now - self._t0, 1e-9)
         window = min(self.qps_window_s, uptime)
         cutoff = now - window
@@ -143,6 +147,7 @@ class ServingStats:
                 "ok": req_ok,
                 "rejected_backpressure": rej_bp,
                 "rejected_deadline": rej_dl,
+                "rejected_breaker": rej_br,
                 "failed": failed,
             },
             "qps": round(recent / window, 3) if window else 0.0,
@@ -183,4 +188,5 @@ class ServingStats:
                     s["batches"]["fill_ratio"], s["compile_cache"]["hits"],
                     s["compile_cache"]["misses"], s["requests"]["ok"],
                     s["requests"]["rejected_backpressure"]
-                    + s["requests"]["rejected_deadline"]))
+                    + s["requests"]["rejected_deadline"]
+                    + s["requests"]["rejected_breaker"]))

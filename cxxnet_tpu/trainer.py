@@ -34,6 +34,7 @@ from .model import Network
 from .optim import create_optimizer
 from .parallel import MeshContext, make_mesh_context
 from .io.data import DataBatch
+from .resilience import failpoints
 from . import checkpoint as ckpt
 
 _METRIC_RE = re.compile(r"^metric(?:\[([^,\]]+)(?:,([^\]]+))?\])?$")
@@ -203,6 +204,7 @@ class Trainer:
         # accumulates metrics only after WaitAllJobs; XLA async dispatch
         # makes the lagged fetch free)
         self._pending_metric = None
+        self._params_finite_fn = None
         if self.batch_size % self.mesh.data_parallel:
             raise ValueError(
                 f"batch_size {self.batch_size} not divisible by data-parallel "
@@ -393,7 +395,9 @@ class Trainer:
         kwargs = dict(
             structure_sig=self.graph.structure_signature(),
             round_counter=self.round_counter, epoch_counter=self.epoch_counter,
-            params=params, net_state=self.net_state, opt_state=opt)
+            params=params, net_state=self.net_state, opt_state=opt,
+            step_count=self._step_count,
+            lr_scale=self.optimizer.lr_scale)
         if not self.save_async:
             ckpt.save_model(path, **kwargs)
             return
@@ -427,11 +431,17 @@ class Trainer:
             if err:
                 raise RuntimeError("async checkpoint write failed") from err[0]
 
-    def load_model(self, path: str) -> None:
+    def load_model(self, path: str, verify: bool = True) -> None:
         self.wait_saves()     # never read a checkpoint mid-write
-        blob = ckpt.load_model(path)
+        self.load_blob(ckpt.load_model(path, verify=verify))
+
+    def load_blob(self, blob: Dict[str, Any]) -> None:
+        """Restore from an already-loaded checkpoint blob (the dict
+        load_model/find_latest_valid produce) — callers that just read
+        and VERIFIED the archive (resume scan, sentinel rollback) hand
+        it over directly instead of paying a second full read."""
         ckpt.check_structure(blob["meta"], self.graph.structure_signature())
-        opt = blob["opt"] if blob["opt"] is not None \
+        opt = blob.get("opt") if blob.get("opt") is not None \
             else self.optimizer.init_state(blob["params"])
         # checkpoints are policy-portable: the fp32 masters restore as-is
         # and the fp16 loss-scaler subtree is injected/dropped to match
@@ -442,6 +452,39 @@ class Trainer:
         self._init_accum(blob["params"])
         self.round_counter = blob["meta"]["round"]
         self.epoch_counter = blob["meta"]["epoch"]
+        # restore the rng-stream position: step N's key re-derives as
+        # fold_in(base_key, step_count) on next use, so a rolled-back run
+        # replays the same dropout/mask stream it would have had (older
+        # checkpoints lack the field — keep the live counter)
+        sc = blob["meta"].get("step_count")
+        if sc is not None:
+            self._step_count = int(sc)
+            self._rng_key = None
+        # sentinel LR backoff survives the restore (absent in pre-v2
+        # metas -> full LR); schedule caches key on VALUES, so drop them
+        self.optimizer.lr_scale = float(blob["meta"].get("lr_scale", 1.0))
+        self._sched_cache = None
+        self._sched_stack_cache = None
+
+    def rollback(self, path: str, blob: Optional[Dict[str, Any]] = None
+                 ) -> int:
+        """Restore params + optimizer state + net state + rng position +
+        LR scale from a verified checkpoint — the sentinel's recovery
+        action after a NaN/loss-spike step. Rides the exact fp32-master
+        restore path load_model uses (policy-portable, sharded
+        placement), then clears everything step-local a poisoned step
+        may have touched. Pass the ``blob`` find_latest_valid already
+        read+verified to skip a second full archive read. Returns the
+        restored round."""
+        self.wait_saves()
+        if blob is not None:
+            self.load_blob(blob)                  # re-zeros accum too
+        else:
+            self.load_model(path)
+        self.sample_counter = 0
+        self._last_loss = None
+        self._pending_metric = None
+        return self.round_counter
 
     def copy_model_from(self, path: str) -> None:
         """Finetune restore: name-matched layer copy from another model."""
@@ -1685,6 +1728,15 @@ class Trainer:
         if self.update_period > 1:
             self.accum = accum
         self._last_loss = loss
+        if failpoints.fire("device.step"):
+            # injected bad step: poison params AND the loss exactly the
+            # way a real divergent/NaN step would — the sentinel must
+            # catch the loss and the rollback must restore the params
+            # (a loss-only poison would let a broken rollback path pass)
+            nan = jnp.float32(float("nan"))
+            self.params = jax.tree_util.tree_map(
+                lambda x: x + nan.astype(x.dtype), self.params)
+            self._last_loss = float("nan")
         self._step_count += 1
         self.sample_counter += 1
         if self.sample_counter >= self.update_period:
@@ -1942,6 +1994,21 @@ class Trainer:
     @property
     def last_loss(self) -> float:
         return float(self._last_loss) if self._last_loss is not None else float("nan")
+
+    def params_finite(self) -> bool:
+        """Device-side finiteness probe over the param masters (one tiny
+        fused reduction). Guards checkpoint writes: a poisoned step whose
+        LOSS was still finite (the apply NaN'd the params after the loss
+        was computed) must not be persisted — the archive would pass
+        integrity verification and every rollback would restore NaN."""
+        if self._params_finite_fn is None:
+            def probe(params):
+                ok = jnp.bool_(True)
+                for leaf in jax.tree_util.tree_leaves(params):
+                    ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(leaf)))
+                return ok
+            self._params_finite_fn = jax.jit(probe)
+        return bool(self._params_finite_fn(self.params))
 
     # -- introspection -----------------------------------------------------
     def step_cost_analysis(self, batch: DataBatch) -> Dict[str, float]:

@@ -1,0 +1,725 @@
+"""Fault-tolerance tests: failpoints, checkpoint integrity + fallback,
+crash-consistent resume, recordio corruption skip, IO retry, the
+training sentinel's rollback loop, and the serve circuit breaker.
+
+Every injected failure is deterministic (failpoints, injectable clocks
+and sleeps) — nothing here may be flaky.
+"""
+
+import json
+import os
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu import checkpoint as ckpt
+from cxxnet_tpu.config import ConfigError, RetryPolicy, parse_retry_policy
+from cxxnet_tpu.io import stream
+from cxxnet_tpu.io.recordio import RecordReader, RecordWriter
+from cxxnet_tpu.resilience import (CircuitBreaker, CircuitOpen,
+                                   SentinelAbort, TrainingSentinel,
+                                   counters, failpoints, retry_call)
+from cxxnet_tpu.resilience.failpoints import FailpointSpecError, Failpoints
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.clear()
+    yield
+    failpoints.clear()
+
+
+# -- failpoints -----------------------------------------------------------
+
+def test_failpoint_modes():
+    fp = Failpoints()
+    fp.configure("a=once, b=every:3, c=prob:0.5, d=0.25")
+    assert fp.active() == {"a": "once", "b": "every:3",
+                           "c": "prob:0.5", "d": "prob:0.25"}
+    # once: exactly one fire, then auto-disarm (history survives)
+    assert fp.fire("a") is True
+    assert fp.fire("a") is False
+    assert not fp.armed("a") and fp.fired("a") == 1
+    # every:3 fires on checks 3, 6, ...
+    assert [fp.fire("b") for _ in range(7)] == [
+        False, False, True, False, False, True, False]
+    # unarmed sites never fire
+    assert fp.fire("nope") is False
+
+
+def test_failpoint_prob_deterministic():
+    """prob sites draw from a per-site seeded RNG: two registries armed
+    identically produce identical fire sequences (chaos runs are
+    reproducible)."""
+    seq = []
+    for _ in range(2):
+        fp = Failpoints()
+        fp.configure("x=prob:0.3")
+        seq.append([fp.fire("x") for _ in range(64)])
+    assert seq[0] == seq[1]
+    assert any(seq[0]) and not all(seq[0])
+
+
+def test_failpoint_spec_errors():
+    fp = Failpoints()
+    for bad in ("a", "a=every:0", "a=prob:1.5", "a=wat"):
+        with pytest.raises(FailpointSpecError):
+            fp.configure(bad)
+
+
+def test_failpoint_env_install(monkeypatch):
+    monkeypatch.setenv(failpoints.ENV_VAR, "z=once,y=off")
+    fp = Failpoints()
+    fp.configure("y=every:2")        # config first...
+    fp.install("", env=True)         # ...env wins on clashes
+    assert fp.active() == {"z": "once"}
+
+
+def test_failpoint_check_raises():
+    fp = Failpoints()
+    fp.set("s", "once")
+    with pytest.raises(IOError):
+        fp.check("s", IOError)
+    fp.check("s", IOError)           # disarmed: no raise
+
+
+# -- retry ----------------------------------------------------------------
+
+def test_retry_succeeds_after_transients():
+    calls, delays = [], []
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+    out = retry_call(flaky, attempts=4, base_delay_s=0.1, max_delay_s=1.0,
+                     jitter=0.0, sleep=delays.append)
+    assert out == "ok" and len(calls) == 3
+    assert delays == [0.1, 0.2]      # deterministic backoff at jitter=0
+
+
+def test_retry_exhausts_and_raises():
+    def always():
+        raise OSError("down")
+    with pytest.raises(OSError):
+        retry_call(always, attempts=3, sleep=lambda _d: None)
+
+
+def test_retry_delay_capped_with_jitter():
+    delays = []
+    seq = iter([1.0, 1.0, 1.0, 1.0, 1.0])
+    def always():
+        raise OSError("down")
+    with pytest.raises(OSError):
+        retry_call(always, attempts=5, base_delay_s=0.1, max_delay_s=0.3,
+                   jitter=1.0, sleep=delays.append,
+                   rng=lambda: next(seq))
+    assert delays == [0.1, 0.2, 0.3, 0.3]   # capped at max_delay_s
+
+
+def test_parse_retry_policy():
+    pol = parse_retry_policy([("io_retry_attempts", "7"),
+                              ("io_retry_base_ms", "10"),
+                              ("io_retry_max_ms", "100"),
+                              ("io_retry_jitter", "0")])
+    assert pol == RetryPolicy(attempts=7, base_delay_s=0.01,
+                              max_delay_s=0.1, jitter=0.0)
+    with pytest.raises(ConfigError):
+        parse_retry_policy([("io_retry_attempts", "0")])
+    # a typo'd knob must error, not silently fall back to defaults
+    with pytest.raises(ConfigError, match="unknown retry setting"):
+        parse_retry_policy([("io_retry_base", "10")])
+
+
+def test_stream_retries_failpoint_open(tmp_path):
+    """An io.open fault on a local path is retried (and counted) by the
+    same machinery remote ops use."""
+    p = str(tmp_path / "x.bin")
+    open(p, "wb").write(b"data")
+    failpoints.set("io.open", "once")
+    before = counters.get("io.retries")
+    with stream.sopen(p, "rb") as f:
+        assert f.read() == b"data"
+    assert counters.get("io.retries") == before + 1
+    assert failpoints.fired("io.open") == 1
+
+
+# -- atomic write / tmp orphans -------------------------------------------
+
+def test_atomic_write_pid_unique_tmp(tmp_path):
+    p = str(tmp_path / "m.bin")
+    stream.write_bytes_atomic(p, b"hello")
+    assert open(p, "rb").read() == b"hello"
+    assert os.listdir(str(tmp_path)) == ["m.bin"]   # no tmp left behind
+
+
+def test_atomic_write_crash_leaves_orphan_and_sweep_cleans(tmp_path):
+    """io.write fires between tmp-write and rename — the crash window.
+    The target is untouched, a pid-suffixed orphan remains, and the
+    resume scan sweeps it."""
+    d = str(tmp_path)
+    p = os.path.join(d, "0001.model")
+    stream.write_bytes_atomic(p, b"good")
+    failpoints.set("io.write", "once")
+    with pytest.raises(IOError):
+        stream.write_bytes_atomic(p, b"new")
+    assert open(p, "rb").read() == b"good"          # old file intact
+    orphans = [f for f in os.listdir(d) if ".tmp" in f]
+    assert len(orphans) == 1 and f".tmp.{os.getpid()}" in orphans[0]
+    # the sweep protects THIS process's tmp files (an async save thread
+    # may own one) — a live-process scan leaves the orphan alone
+    ckpt.find_latest_valid(d)
+    assert [f for f in os.listdir(d) if ".tmp" in f] == orphans
+    # a FRESH foreign tmp is presumed to belong to a live writer in
+    # another process and is protected too
+    foreign = os.path.join(d, orphans[0].replace(str(os.getpid()),
+                                                 "99999"))
+    os.rename(os.path.join(d, orphans[0]), foreign)
+    ckpt.find_latest_valid(d)
+    assert os.path.exists(foreign)
+    # the real crash recovery: the orphan AGES past the threshold (the
+    # dead writer never comes back) and the next scan sweeps it
+    old = time.time() - ckpt.TMP_SWEEP_MIN_AGE_S - 10
+    os.utime(foreign, (old, old))
+    ckpt.find_latest_valid(d)
+    assert [f for f in os.listdir(d) if ".tmp" in f] == []
+
+
+# -- checkpoint integrity -------------------------------------------------
+
+def _save(path, params, rnd=1, step=10):
+    ckpt.save_model(path, structure_sig=("sig",), round_counter=rnd,
+                    epoch_counter=rnd * 8, params=params, net_state={},
+                    opt_state={"mom": params}, step_count=step)
+
+
+def _params(seed=0):
+    r = np.random.RandomState(seed)
+    return {"fc1": {"wmat": r.randn(4, 3).astype(np.float32),
+                    "bias": r.randn(4).astype(np.float32)}}
+
+
+def test_checkpoint_digests_roundtrip(tmp_path):
+    p = str(tmp_path / "0001.model")
+    _save(p, _params())
+    meta = ckpt.verify_model(p)
+    assert meta["round"] == 1 and meta["step_count"] == 10
+    assert "params/fc1/wmat" in meta["digests"]
+    blob = ckpt.load_model(p)                       # verify=True default
+    np.testing.assert_array_equal(blob["params"]["fc1"]["wmat"],
+                                  _params()["fc1"]["wmat"])
+
+
+def test_checkpoint_digest_mismatch_detected(tmp_path):
+    """An archive that UNZIPS fine but holds a tampered array (stale
+    digest map) is caught by verification — the case zip CRCs alone
+    cannot express (a 'successful' write of the wrong bytes)."""
+    p = str(tmp_path / "0001.model")
+    _save(p, _params())
+    # rebuild the archive with one perturbed array + the ORIGINAL meta
+    with np.load(p, allow_pickle=False) as z:
+        arrays = {k: z[k] for k in z.files}
+    meta = json.loads(bytes(arrays["__meta__"]).decode())
+    arrays["params/fc1/wmat"] = arrays["params/fc1/wmat"] + 1.0
+    arrays["__meta__"] = np.frombuffer(json.dumps(meta).encode(),
+                                       dtype=np.uint8)
+    import io as _io
+    buf = _io.BytesIO()
+    np.savez(buf, **arrays)
+    open(p, "wb").write(buf.getvalue())
+    with pytest.raises(ckpt.CheckpointCorrupt, match="digest mismatch"):
+        ckpt.load_model(p)
+    assert ckpt.load_model(p, verify=False)["meta"]["round"] == 1
+
+
+def test_checkpoint_truncation_detected(tmp_path):
+    p = str(tmp_path / "0002.model")
+    _save(p, _params(), rnd=2)
+    b = open(p, "rb").read()
+    open(p, "wb").write(b[:len(b) // 2])
+    with pytest.raises(ckpt.CheckpointCorrupt):
+        ckpt.load_model(p)
+    with pytest.raises(ckpt.CheckpointCorrupt):
+        ckpt.load_for_inference(p)
+
+
+def test_find_latest_accepts_5_digit_rounds(tmp_path):
+    """%04d does not truncate: round 10000 writes '10000.model' and the
+    scan must resume from it, not silently stop at 9999."""
+    d = str(tmp_path)
+    for r in (9999, 10000):
+        _save(os.path.join(d, "%04d.model" % r), _params(), rnd=r)
+    assert ckpt.find_latest(d)[0] == 10000
+    assert ckpt.find_latest_valid(d)[0] == 10000
+
+
+def test_find_latest_valid_falls_back_past_corrupt(tmp_path):
+    d = str(tmp_path)
+    for r in (1, 2, 3):
+        _save(os.path.join(d, "%04d.model" % r), _params(r), rnd=r)
+    newest = os.path.join(d, "0003.model")
+    b = open(newest, "rb").read()
+    open(newest, "wb").write(b[: len(b) // 3])      # torn by a kill
+    before = counters.get("ckpt.skipped_invalid")
+    r, path = ckpt.find_latest_valid(d)
+    assert (r, os.path.basename(path)) == (2, "0002.model")
+    assert counters.get("ckpt.skipped_invalid") == before + 1
+    # all-corrupt dir -> None (resume starts fresh rather than crashing)
+    b2 = open(path, "rb").read()
+    open(path, "wb").write(b2[:10])
+    open(os.path.join(d, "0001.model"), "wb").write(b"junk")
+    assert ckpt.find_latest_valid(d) is None
+
+
+def test_rotate_checkpoints(tmp_path):
+    d = str(tmp_path)
+    for r in range(5):
+        _save(os.path.join(d, "%04d.model" % r), _params(), rnd=r)
+    deleted = ckpt.rotate_checkpoints(d, keep_last_n=2)
+    assert sorted(os.path.basename(p) for p in deleted) == [
+        "0000.model", "0001.model", "0002.model"]
+    assert sorted(os.listdir(d)) == ["0003.model", "0004.model"]
+    assert ckpt.rotate_checkpoints(d, keep_last_n=0) == []   # disabled
+
+
+# -- recordio corruption skip ---------------------------------------------
+
+def _write_rec(path, payloads):
+    with RecordWriter(path) as w:
+        for p in payloads:
+            w.write(p)
+
+
+def test_recordio_skips_exactly_one_corrupt_record(tmp_path):
+    p = str(tmp_path / "a.rec")
+    payloads = [bytes([i]) * (10 + i) for i in range(5)]
+    _write_rec(p, payloads)
+    # corrupt record #2's magic in place (offsets: 8-byte header + payload,
+    # padded to 8)
+    offs = [0]
+    for pl in payloads[:-1]:
+        n = 8 + len(pl)
+        offs.append(offs[-1] + n + (-n) % 8)
+    with open(p, "r+b") as f:
+        f.seek(offs[2])
+        f.write(struct.pack("<I", 0xDEADBEEF))
+    before = counters.get("recordio.skipped")
+    rd = RecordReader(p)
+    got = list(rd)
+    assert got == payloads[:2] + payloads[3:]       # exactly #2 missing
+    assert rd.skipped == 1
+    assert counters.get("recordio.skipped") == before + 1
+
+
+def test_recordio_skip_bound_raises(tmp_path):
+    """``skipped`` counts corruption EVENTS (one per resync); alternate
+    corrupt/valid records produce one event each, and the bound trips."""
+    p = str(tmp_path / "b.rec")
+    _write_rec(p, [b"x" * 12] * 8)
+    sz = 8 + 12 + 4                                  # hdr + payload + pad
+    with open(p, "r+b") as f:
+        for i in (1, 3, 5, 7):                       # every other record
+            f.seek(i * sz)
+            f.write(struct.pack("<I", 0x0BADF00D))
+    rd = RecordReader(p, max_skip=10)
+    assert len(list(rd)) == 4 and rd.skipped == 4
+    rd2 = RecordReader(p, max_skip=2)
+    with pytest.raises(IOError, match="max_skip"):
+        list(rd2)
+
+
+def test_recordio_corrupt_length_mid_file_counted(tmp_path):
+    """A bit-flipped LENGTH field (magic intact) reads short to EOF —
+    that must count as a skip and resync, not silently drop the rest of
+    the shard like a torn tail."""
+    p = str(tmp_path / "ln.rec")
+    _write_rec(p, [b"m" * 12] * 4)
+    sz = 8 + 12 + 4
+    with open(p, "r+b") as f:
+        f.seek(1 * sz + 4)                           # record 1's ln field
+        f.write(struct.pack("<I", 1 << 30))
+    rd = RecordReader(p)
+    assert list(rd) == [b"m" * 12] * 3               # record 1 dropped
+    assert rd.skipped == 1
+
+
+def test_recordio_truncated_tail_ends_cleanly(tmp_path):
+    p = str(tmp_path / "c.rec")
+    _write_rec(p, [b"a" * 16, b"b" * 16])
+    b = open(p, "rb").read()
+    open(p, "wb").write(b[:-10])                     # torn final record
+    rd = RecordReader(p)
+    assert list(rd) == [b"a" * 16]
+    assert rd.skipped == 0                           # a tear, not rot
+
+
+def test_recordio_decode_failpoint(tmp_path):
+    p = str(tmp_path / "d.rec")
+    _write_rec(p, [b"q" * 8] * 4)
+    failpoints.set("record.decode", "every:2")
+    rd = RecordReader(p)
+    assert len(list(rd)) == 2                        # 2 of 4 injected away
+    assert rd.skipped == 2
+
+
+# -- sentinel -------------------------------------------------------------
+
+def test_sentinel_nan_and_spike():
+    s = TrainingSentinel(spike_factor=5.0, window=16, min_history=4)
+    for v in (1.0, 0.9, 1.1, 1.0):
+        assert s.observe(v) is None
+    assert "spike" in s.observe(100.0)               # 100 > 5 x median 1
+    assert s.observe(1.05) is None                   # spike not admitted
+    assert "non-finite" in s.observe(float("nan"))
+    assert "non-finite" in s.observe(1.0, grad_norm=float("inf"))
+
+
+def test_sentinel_min_history_guard():
+    """Warmup noise before min_history healthy points never trips the
+    spike detector (first-steps losses are legitimately wild)."""
+    s = TrainingSentinel(spike_factor=2.0, window=16, min_history=8)
+    for v in (10.0, 1.0, 30.0, 0.5, 20.0):
+        assert s.observe(v) is None
+
+
+def test_sentinel_spike_disabled():
+    s = TrainingSentinel(spike_factor=0.0, window=8, min_history=1)
+    for v in (1.0, 1e9, 1.0):
+        assert s.observe(v) is None
+    assert s.observe(float("inf")) is not None       # NaN/Inf stays on
+
+
+def test_sentinel_rollback_budget():
+    s = TrainingSentinel(max_rollbacks=2)
+    s.record_rollback(3, "nan")
+    s.record_rollback(2, "nan")
+    with pytest.raises(SentinelAbort, match="max_rollbacks"):
+        s.record_rollback(1, "nan")
+    assert "rollback #2" in s.report()
+
+
+def test_sentinel_reset_window():
+    s = TrainingSentinel(spike_factor=3.0, window=8, min_history=2)
+    for v in (1.0, 1.0, 1.0):
+        s.observe(v)
+    s.reset_window()
+    assert s.observe(50.0) is None    # fresh baseline after rollback
+
+
+# -- circuit breaker ------------------------------------------------------
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_breaker_opens_after_consecutive_failures():
+    clk = _Clock()
+    b = CircuitBreaker(failure_threshold=3, reset_timeout_s=5.0, clock=clk)
+    b.record_failure(); b.record_failure()
+    assert b.state == "closed" and b.allow()
+    b.record_success()                               # success resets streak
+    b.record_failure(); b.record_failure()
+    assert b.state == "closed"
+    b.record_failure()
+    assert b.state == "open" and not b.allow()
+    assert b.snapshot()["opens"] == 1
+
+
+def test_breaker_half_open_probe_recovers():
+    clk = _Clock()
+    b = CircuitBreaker(failure_threshold=1, reset_timeout_s=5.0, clock=clk)
+    b.record_failure()
+    assert b.state == "open"
+    clk.t = 4.9
+    assert not b.allow()
+    clk.t = 5.1
+    assert b.allow()                                 # the half-open probe
+    assert b.state == "half_open"
+    assert not b.allow()                             # only ONE probe
+    b.record_success()
+    assert b.state == "closed" and b.allow()
+
+
+def test_breaker_half_open_probe_failure_reopens():
+    clk = _Clock()
+    b = CircuitBreaker(failure_threshold=1, reset_timeout_s=5.0, clock=clk)
+    b.record_failure()
+    clk.t = 6.0
+    assert b.allow()
+    b.record_failure()                               # probe failed
+    assert b.state == "open"
+    clk.t = 10.0                                     # timer restarted at 6
+    assert not b.allow()
+    clk.t = 11.5
+    assert b.allow()
+
+
+def test_breaker_lost_probe_rearms():
+    """A probe that never reports a verdict (rejected by a later gate,
+    expired at flush, client gone) must not wedge the breaker in
+    half_open: after another reset period a replacement probe is
+    armed."""
+    clk = _Clock()
+    b = CircuitBreaker(failure_threshold=1, reset_timeout_s=5.0, clock=clk)
+    b.record_failure()
+    clk.t = 5.5
+    assert b.allow()                                 # probe 1 — vanishes
+    assert not b.allow()
+    clk.t = 10.0
+    assert not b.allow()                             # not yet
+    clk.t = 10.6
+    assert b.allow()                                 # replacement probe
+    b.record_success()
+    assert b.state == "closed"
+
+
+def test_breaker_effective_state_reports_probe_ready():
+    """An open breaker past its reset timeout reads half_open via
+    effective_state() (health endpoints) while raw state stays open —
+    a drained-on-503 load balancer needs the 200 to resume the traffic
+    recovery depends on."""
+    clk = _Clock()
+    b = CircuitBreaker(failure_threshold=1, reset_timeout_s=5.0, clock=clk)
+    b.record_failure()
+    assert (b.state, b.effective_state()) == ("open", "open")
+    clk.t = 5.5
+    assert (b.state, b.effective_state()) == ("open", "half_open")
+    assert b.allow()                                 # probe not consumed ^
+
+
+# -- end-to-end: trainer + round loop -------------------------------------
+
+TRAIN_CFG = """
+data = train
+iter = synthetic
+  num_inst = 512
+  num_class = 5
+  input_shape = 1,1,16
+  seed_data = 3
+iter = end
+netconfig=start
+layer[+1:h1] = fullc:fc1
+  nhidden = 24
+  random_type = xavier
+layer[+1:a1] = relu
+layer[a1->out] = fullc:fc2
+  nhidden = 5
+  random_type = xavier
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,16
+batch_size = 64
+eta = 0.3
+dev = cpu
+eval_train = 0
+print_step = 0
+silent = 1
+save_period = 1
+"""
+
+
+def _task(tmpdir, extra=""):
+    from cxxnet_tpu.config import parse_config_string
+    from cxxnet_tpu.main import LearnTask
+    cfg = TRAIN_CFG + f"\nmodel_dir = {tmpdir}\n" + extra
+    return LearnTask(parse_config_string(cfg))
+
+
+def _gathered(tr):
+    import jax
+    return jax.tree_util.tree_map(np.asarray, tr.mesh.gather(tr.params))
+
+
+def test_resume_falls_back_bit_exact_after_truncation(tmp_path):
+    """Crash consistency: kill-mid-write leaves the newest checkpoint
+    torn and a .tmp orphan; ``continue=1`` must resume from the
+    PREVIOUS round with params bit-exact to that checkpoint."""
+    d = str(tmp_path)
+    _task(d, "num_round = 3\n").run()
+    assert sorted(os.listdir(d)) == [
+        "0000.model", "0001.model", "0002.model"]
+    newest = os.path.join(d, "0002.model")
+    b = open(newest, "rb").read()
+    open(newest, "wb").write(b[: len(b) // 2])       # the kill
+    orphan = os.path.join(d, "0003.model.tmp.999")
+    open(orphan, "wb").write(b"junk")
+    old = time.time() - ckpt.TMP_SWEEP_MIN_AGE_S - 10
+    os.utime(orphan, (old, old))                     # dead-writer age
+    task = _task(d, "num_round = 5\ncontinue = 1\n")
+    task._init_model()
+    assert task.start_counter == 2                   # round 1 + 1
+    assert not os.path.exists(orphan)
+    want = ckpt.load_model(os.path.join(d, "0001.model"))["params"]
+    got = _gathered(task.trainer)
+    for lname, lp in want.items():
+        for tag, arr in lp.items():
+            np.testing.assert_array_equal(got[lname][tag], arr)
+
+
+def test_sentinel_rolls_back_injected_nan_and_run_completes(tmp_path):
+    """The chaos centerpiece in miniature: a NaN step injected at
+    device.step poisons params + loss; the sentinel rolls back to the
+    last verified checkpoint, LR backs off, training finishes."""
+    d = str(tmp_path)
+    # 8 batches/round x 3 rounds = 24 steps; every:20 fires exactly once;
+    # interval 1 detects at the poisoned step itself (the amortized
+    # default cadence is exercised by tools/chaos_train.py)
+    task = _task(
+        d, "num_round = 3\nsentinel_interval = 1\n"
+           "failpoints = \"device.step=every:20\"\n")
+    task.run()
+    assert task.sentinel is not None
+    assert task.sentinel.rollbacks == 1
+    assert task.trainer.optimizer.lr_scale == pytest.approx(0.5)
+    assert np.isfinite(task.trainer.last_loss)
+    # every round checkpoint exists and verifies (the NaN never landed)
+    for r in range(3):
+        ckpt.verify_model(os.path.join(d, "%04d.model" % r))
+    for lp in _gathered(task.trainer).values():
+        for arr in lp.values():
+            assert np.all(np.isfinite(arr))
+
+
+def test_save_round_refuses_poisoned_params(tmp_path):
+    """A step whose apply NaN'd the params after a FINITE loss must not
+    be checkpointed: the archive would pass digest verification and
+    every rollback would faithfully restore the poison."""
+    import jax
+    import jax.numpy as jnp
+    d = str(tmp_path)
+    task = _task(d, "num_round = 1\n")
+    tr = task.trainer
+    tr.init_model()
+    task.sentinel = TrainingSentinel()
+    tr.params = jax.tree_util.tree_map(
+        lambda x: x + jnp.asarray(float("nan"), x.dtype), tr.params)
+    before = counters.get("ckpt.skipped_poisoned")
+    task._save_round(tr, 0)
+    assert counters.get("ckpt.skipped_poisoned") == before + 1
+    assert os.listdir(d) == []                       # nothing written
+
+
+def test_lr_backoff_survives_crash_and_resume(tmp_path):
+    """The backed-off LR is persisted in checkpoint meta: a crash after
+    a rollback must NOT resume at full LR (a deterministically spiking
+    run would crash-loop under a restart supervisor otherwise)."""
+    d = str(tmp_path)
+    task = _task(d, "num_round = 3\nsentinel_interval = 1\n"
+                    "failpoints = \"device.step=every:20\"\n")
+    task.run()
+    assert task.trainer.optimizer.lr_scale == pytest.approx(0.5)
+    # "crash" + supervisor restart: a fresh process resumes continue=1
+    task2 = _task(d, "num_round = 4\ncontinue = 1\n")
+    task2._init_model()
+    assert task2.trainer.optimizer.lr_scale == pytest.approx(0.5)
+
+
+def test_sentinel_aborts_without_checkpoint(tmp_path):
+    """An anomaly before ANY valid checkpoint exists is unrecoverable:
+    abort with the sentinel report, not an infinite loop."""
+    task = _task(str(tmp_path),
+                 "num_round = 2\nsave_period = 0\n"
+                 "failpoints = \"device.step=every:2\"\n")
+    with pytest.raises(SentinelAbort, match="no valid checkpoint"):
+        task.run()
+
+
+def test_ckpt_write_failure_tolerated_and_keep_last_n(tmp_path):
+    """A failed periodic checkpoint write degrades (counted, logged)
+    instead of killing training; rotation keeps only keep_last_n."""
+    d = str(tmp_path)
+    before = counters.get("ckpt.write_failures")
+    task = _task(d, "num_round = 4\nkeep_last_n = 2\n"
+                    "failpoints = \"ckpt.write=once\"\n")
+    task.run()
+    assert counters.get("ckpt.write_failures") == before + 1
+    assert sorted(os.listdir(d)) == ["0002.model", "0003.model"]
+
+
+# -- end-to-end: serve breaker + health -----------------------------------
+
+SERVE_CFG = """
+netconfig=start
+layer[+1:h1] = fullc:fc1
+  nhidden = 24
+  random_type = xavier
+layer[+1:a1] = relu
+layer[a1->out] = fullc:fc2
+  nhidden = 5
+  random_type = xavier
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,16
+batch_size = 32
+eta = 0.3
+"""
+
+
+@pytest.fixture()
+def serve_server(mesh1):
+    from cxxnet_tpu.config import parse_config_string
+    from cxxnet_tpu.serve import InferenceEngine
+    from cxxnet_tpu.serve.server import ServeServer
+    from cxxnet_tpu.trainer import Trainer
+    tr = Trainer(parse_config_string(SERVE_CFG), mesh_ctx=mesh1)
+    tr.init_model()
+    engine = InferenceEngine(tr, buckets="4,8", max_batch=8)
+    srv = ServeServer(engine, port=0, max_latency_ms=2.0,
+                      breaker_threshold=2, breaker_reset_s=0.25,
+                      silent=True)
+    yield srv
+    srv.batcher.close(drain=False, timeout=5)
+    srv.httpd.server_close()
+
+
+def test_serve_breaker_opens_then_recovers_via_probe(serve_server):
+    srv = serve_server
+    x = np.random.RandomState(0).randn(2, 16).astype(np.float32)
+    # healthy baseline
+    assert srv.batcher.submit(x).result(timeout=10).shape == (2,)
+    code, h = srv.health()
+    assert (code, h["status"]) == (200, "ok")
+    # two consecutive injected dispatch failures -> breaker opens
+    for _ in range(2):
+        failpoints.set("serve.infer", "once")
+        with pytest.raises(RuntimeError, match="serve.infer"):
+            srv.batcher.submit(x).result(timeout=10)
+    assert srv.breaker.state == "open"
+    code, h = srv.health()
+    assert (code, h["status"]) == (503, "open")
+    # fail-fast while open (no batching-window wait, no dispatch)
+    with pytest.raises(CircuitOpen):
+        srv.batcher.submit(x)
+    assert srv.stats.snapshot()["requests"]["rejected_breaker"] == 1
+    # past the reset timeout health downgrades open -> degraded (probe-
+    # ready) so a drained load balancer resumes routing; the next
+    # request is the half-open probe — the fault is disarmed so it
+    # succeeds and the breaker closes
+    time.sleep(0.3)
+    code, h = srv.health()
+    assert (code, h["status"], h["breaker"]) == (200, "degraded",
+                                                 "half_open")
+    assert srv.batcher.submit(x).result(timeout=10).shape == (2,)
+    assert srv.breaker.state == "closed"
+    code, h = srv.health()
+    assert (code, h["status"]) == (200, "ok")
+    snap = srv.statz()
+    assert snap["breaker"]["opens"] == 1 and snap["breaker"]["probes"] == 1
+
+
+def test_serve_health_degraded_on_skipped_records(serve_server):
+    """Corrupt records skipped DURING this server's lifetime mark it
+    degraded; skips from before it started (training in the same
+    process) do not."""
+    code, h = serve_server.health()
+    assert (code, h["status"]) == (200, "ok")
+    counters.inc("recordio.skipped")
+    code, h = serve_server.health()
+    assert (code, h["status"]) == (200, "degraded")
+    assert h["skipped_records"] == 1
