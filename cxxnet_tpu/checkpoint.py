@@ -36,6 +36,15 @@ import numpy as np
 
 from .io import stream
 from .resilience import counters, failpoints
+from .telemetry.registry import REGISTRY
+from .telemetry.trace import TRACER
+
+# checkpoint IO durations land in one labeled histogram so MFU-eating
+# save stalls show up in the same scrape as the serve/step metrics
+_H_CKPT = REGISTRY.histogram(
+    "cxxnet_ckpt_io_seconds",
+    "Checkpoint archive IO duration by operation",
+    labels=("op",))
 
 
 class CheckpointCorrupt(IOError):
@@ -93,6 +102,26 @@ def save_model(path: str, *, structure_sig: tuple, round_counter: int,
                epoch_counter: int, params: Any, net_state: Any,
                opt_state: Optional[Any] = None, step_count: int = 0,
                lr_scale: float = 1.0) -> None:
+    t0 = time.perf_counter()
+    try:
+        _save_model(path, structure_sig=structure_sig,
+                    round_counter=round_counter,
+                    epoch_counter=epoch_counter, params=params,
+                    net_state=net_state, opt_state=opt_state,
+                    step_count=step_count, lr_scale=lr_scale)
+    finally:
+        # span + histogram recorded on the WRITING thread (covers the
+        # save_async path too); failures still count their duration
+        t1 = time.perf_counter()
+        _H_CKPT.labels("save").observe(t1 - t0)
+        TRACER.add_complete("ckpt.save", t0, t1, cat="ckpt",
+                            args={"round": round_counter})
+
+
+def _save_model(path: str, *, structure_sig: tuple, round_counter: int,
+                epoch_counter: int, params: Any, net_state: Any,
+                opt_state: Optional[Any] = None, step_count: int = 0,
+                lr_scale: float = 1.0) -> None:
     failpoints.check("ckpt.write", IOError)
     arrays: Dict[str, np.ndarray] = {}
     _flatten("params", jax_to_numpy(params), arrays)
@@ -130,6 +159,17 @@ def _load_groups(path: str, include_opt: bool, verify: bool = True):
     recomputes each loaded array's sha256 against the meta digest map
     (format_version >= 2; older archives have no digests and only get
     the torn-archive structural checks)."""
+    t0 = time.perf_counter()
+    try:
+        return _load_groups_inner(path, include_opt, verify)
+    finally:
+        t1 = time.perf_counter()
+        _H_CKPT.labels("load").observe(t1 - t0)
+        TRACER.add_complete("ckpt.load", t0, t1, cat="ckpt",
+                            args={"path": os.path.basename(path)})
+
+
+def _load_groups_inner(path: str, include_opt: bool, verify: bool = True):
     import zipfile
     try:
         if stream.is_remote(path) or failpoints.armed_prefix("io."):

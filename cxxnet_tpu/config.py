@@ -74,6 +74,79 @@ class Policy:
         return jnp.dtype(self.compute_dtype).name
 
 
+# -- telemetry ----------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class TelemetryConfig:
+    """The ``telemetry_*`` knob set (doc/tasks.md "Telemetry"). Every
+    field's zero value means OFF; an unconfigured run pays nothing."""
+    trace_path: str = ""          # telemetry_trace: Chrome-trace JSON out
+    trace_capacity: int = 65536   # telemetry_trace_capacity: span ring
+    sync_interval: int = 8        # telemetry_sync_interval: probe cadence
+    port: int = 0                 # telemetry_port: standalone /metrics
+    log_path: str = ""            # telemetry_log: JSONL snapshots
+    log_interval_s: float = 5.0   # telemetry_log_interval (seconds)
+    log_max_kb: int = 1024        # telemetry_log_max_kb: rotate beyond
+    profile_steps: str = ""       # telemetry_profile_steps: "a-b"
+    profile_dir: str = ""         # telemetry_profile_dir: xprof dump dir
+    steptime: int = 1             # telemetry_steptime: 0 disables probe
+
+
+def parse_telemetry_config(cfg: ConfigPairs) -> TelemetryConfig:
+    """Collect/validate the ``telemetry_*`` keys (last occurrence wins;
+    unknown keys in the namespace fail fast, same contract as
+    ``io_retry_*``)."""
+    known = {
+        "telemetry_trace": ("trace_path", str),
+        "telemetry_trace_capacity": ("trace_capacity", int),
+        "telemetry_sync_interval": ("sync_interval", int),
+        "telemetry_port": ("port", int),
+        "telemetry_log": ("log_path", str),
+        "telemetry_log_interval": ("log_interval_s", float),
+        "telemetry_log_max_kb": ("log_max_kb", int),
+        "telemetry_profile_steps": ("profile_steps", str),
+        "telemetry_profile_dir": ("profile_dir", str),
+        "telemetry_steptime": ("steptime", int),
+    }
+    vals = {}
+    for name, val in cfg:
+        if name.startswith("telemetry_"):
+            if name not in known:
+                raise ConfigError(
+                    f"unknown telemetry setting {name!r}; valid keys: "
+                    + ", ".join(sorted(known)))
+            field, conv = known[name]
+            try:
+                vals[field] = conv(val)
+            except ValueError as e:
+                raise ConfigError(f"bad {name} value {val!r}: {e}")
+    tc = TelemetryConfig(**vals)
+    if tc.trace_capacity < 1:
+        raise ConfigError(
+            f"telemetry_trace_capacity must be >= 1, got "
+            f"{tc.trace_capacity}")
+    if tc.sync_interval < 1:
+        raise ConfigError(
+            f"telemetry_sync_interval must be >= 1, got "
+            f"{tc.sync_interval}")
+    if tc.log_max_kb < 1:
+        raise ConfigError(
+            f"telemetry_log_max_kb must be >= 1, got {tc.log_max_kb}")
+    if tc.log_interval_s <= 0:
+        raise ConfigError(
+            f"telemetry_log_interval must be > 0, got "
+            f"{tc.log_interval_s}")
+    if tc.profile_steps:
+        from .telemetry.profiler import parse_step_range
+        try:
+            parse_step_range(tc.profile_steps)
+        except ValueError as e:
+            raise ConfigError(str(e))
+        if not tc.profile_dir:
+            tc = dataclasses.replace(tc, profile_dir="./profile_dump")
+    return tc
+
+
 # -- IO retry policy ----------------------------------------------------------
 
 @dataclasses.dataclass(frozen=True)

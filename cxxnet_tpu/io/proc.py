@@ -17,21 +17,32 @@ tests can assert that training actually learns.
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
+import time
 from typing import List, Optional
 
 import numpy as np
 
 from .data import DataBatch, DataIter, register_iter
+from ..telemetry.registry import REGISTRY
 from . import iter_mnist  # noqa: F401  (register mnist)
+
+_TB_SEQ = itertools.count()
 
 
 @register_iter("threadbuffer")
 class ThreadBufferIterator(DataIter):
     """Background-thread prefetch with a bounded queue. The reference uses a
     semaphore-handshake double buffer (thread_buffer.h:22-205); a queue of
-    depth ``buffer_size`` generalizes it (depth 1 == double buffering)."""
+    depth ``buffer_size`` generalizes it (depth 1 == double buffering).
+
+    Telemetry: queue depth rides a per-instance gauge
+    (``cxxnet_io_prefetch_queue_depth``) — the is-the-input-pipeline-
+    keeping-up signal the step-time probe's data-wait EMA corroborates —
+    and each upstream fetch lands in the
+    ``cxxnet_io_fetch_latency_seconds`` histogram."""
 
     def set_param(self, name, val):
         if name == "buffer_size":
@@ -43,6 +54,13 @@ class ThreadBufferIterator(DataIter):
         self._queue: Optional[queue.Queue] = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        self._g_depth = REGISTRY.gauge(
+            "cxxnet_io_prefetch_queue_depth",
+            "Batches buffered ahead by the threadbuffer iterator",
+            labels=("iter",)).labels(str(next(_TB_SEQ)))
+        self._h_fetch = REGISTRY.histogram(
+            "cxxnet_io_fetch_latency_seconds",
+            "Upstream batch-fetch latency inside the prefetch producer")
         super().__init__(cfg)
 
     def init(self):
@@ -51,30 +69,51 @@ class ThreadBufferIterator(DataIter):
     def _producer(self):
         self.base.before_first()
         while not self._stop.is_set():
+            t0 = time.perf_counter()
             batch = self.base.next()
-            self._queue.put(batch)
+            self._h_fetch.observe(time.perf_counter() - t0)
+            # TIMED put re-checking _stop: a plain blocking put deadlocks
+            # teardown when the queue is full and the consumer has
+            # stopped draining (before_first's join would wait forever
+            # on a producer stuck in put) — the PR-1..3 shutdown hang
+            while not self._stop.is_set():
+                try:
+                    self._queue.put(batch, timeout=0.05)
+                    self._g_depth.set(self._queue.qsize())
+                    break
+                except queue.Full:
+                    continue
             if batch is None:
                 return
 
     def before_first(self):
-        # tear down any in-flight producer, then restart
+        # tear down any in-flight producer, then restart: signal stop,
+        # then DRAIN-AND-JOIN in a loop — one drain pass is not enough,
+        # because the producer may refill the freed slot before it
+        # observes _stop (the timed put above bounds how long that goes
+        # on; without it this join could hang)
         if self._thread is not None and self._thread.is_alive():
             self._stop.set()
-            try:
-                while True:
-                    self._queue.get_nowait()
-            except queue.Empty:
-                pass
-            self._thread.join()
+            while self._thread.is_alive():
+                try:
+                    while True:
+                        self._queue.get_nowait()
+                except queue.Empty:
+                    pass
+                self._thread.join(timeout=0.05)
         self._stop.clear()
         self._queue = queue.Queue(maxsize=self.buffer_size)
-        self._thread = threading.Thread(target=self._producer, daemon=True)
+        self._g_depth.set(0)
+        self._thread = threading.Thread(target=self._producer, daemon=True,
+                                        name="io-threadbuffer")
         self._thread.start()
 
     def next(self):
         if self._queue is None:
             self.before_first()
-        return self._queue.get()
+        batch = self._queue.get()
+        self._g_depth.set(self._queue.qsize())
+        return batch
 
 
 @register_iter("membuffer")

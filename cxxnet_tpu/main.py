@@ -20,10 +20,12 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from .config import (ConfigPairs, parse_cli_overrides, parse_config_file,
-                     parse_retry_policy)
+                     parse_retry_policy, parse_telemetry_config)
 from .graph import global_param
 from .io.data import DataBatch, create_iterator
 from .resilience import SentinelAbort, TrainingSentinel, counters, failpoints
+from .telemetry import TelemetrySession
+from .telemetry.trace import TRACER
 from .trainer import Trainer
 from . import checkpoint as ckpt
 
@@ -122,6 +124,12 @@ class LearnTask:
         stream.set_retry_policy(parse_retry_policy(self.global_cfg))
         # checkpoint hygiene: keep only the newest N (0 = keep all)
         self.keep_last_n = int(gp("keep_last_n", "0"))
+        # -- telemetry (doc/tasks.md "Telemetry") -------------------------
+        # telemetry_trace / telemetry_port / telemetry_log /
+        # telemetry_profile_steps / telemetry_sync_interval — one
+        # validated knob set; the SESSION is built after multi-host
+        # bring-up below (exporters are root-rank-only)
+        self.telemetry_cfg = parse_telemetry_config(self.global_cfg)
         # loss sentinel: NaN/Inf detection is on by default (sentinel=0
         # disables); spikes trip at sentinel_spike_factor x rolling
         # median (0 disables spike detection only). Every anomaly rolls
@@ -164,6 +172,20 @@ class LearnTask:
         self._is_root = jax.process_index() == 0
         if not self._is_root:
             self.silent = 1
+            # non-root ranks keep the step-time probe (it is local and
+            # silent) but must not bind the scrape port or clobber the
+            # root's trace/log files — root-only observability, same
+            # policy as progress logging
+            import dataclasses as _dc
+            self.telemetry_cfg = _dc.replace(
+                self.telemetry_cfg, port=0, trace_path="", log_path="")
+        # the session enables the tracer and starts the JSONL logger /
+        # standalone /metrics endpoint immediately; run() closes it
+        # (trace dump + final log flush). Built in __init__, not run(),
+        # so tools that drive task_* methods directly still get a live
+        # session.
+        self.telemetry = TelemetrySession(self.telemetry_cfg,
+                                          silent=bool(self.silent))
         self.trainer = Trainer(self.global_cfg)
 
     # -- iterators ---------------------------------------------------------
@@ -245,20 +267,24 @@ class LearnTask:
 
     # -- tasks -------------------------------------------------------------
     def run(self) -> None:
-        if self.task in ("train", "finetune"):
-            self.task_train()
-        elif self.task == "pred":
-            self.task_predict()
-        elif self.task == "pred_raw":
-            self.task_predict_raw()
-        elif self.task in ("extract", "extract_feature"):
-            self.task_extract()
-        elif self.task == "get_weight":
-            self.task_get_weight()
-        elif self.task == "serve":
-            self.task_serve()
-        else:
-            raise ValueError(f"unknown task {self.task!r}")
+        try:
+            if self.task in ("train", "finetune"):
+                self.task_train()
+            elif self.task == "pred":
+                self.task_predict()
+            elif self.task == "pred_raw":
+                self.task_predict_raw()
+            elif self.task in ("extract", "extract_feature"):
+                self.task_extract()
+            elif self.task == "get_weight":
+                self.task_get_weight()
+            elif self.task == "serve":
+                self.task_serve()
+            else:
+                raise ValueError(f"unknown task {self.task!r}")
+        finally:
+            self.telemetry.close(
+                ready=self.trainer.last_loss_handle)
 
     def task_train(self) -> None:
         tr = self.trainer
@@ -400,6 +426,22 @@ class LearnTask:
         if self.keep_last_n:
             ckpt.rotate_checkpoints(self.model_dir, self.keep_last_n)
 
+    def _timed_batches(self, it, probe):
+        """Wrap a batch source so each fetch's host-blocked time is
+        banked into the step-time probe (data-wait) and traced."""
+        it = iter(it)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            t1 = time.perf_counter()
+            if probe is not None:
+                probe.note_data_wait(t1 - t0)
+            TRACER.add_complete("train.data_wait", t0, t1, cat="train")
+            yield batch
+
     def _train_rounds(self, tr, itr_train, evals) -> None:
         start = time.time()
         end_round = self.num_round
@@ -416,6 +458,14 @@ class LearnTask:
                 window=self.sentinel_window,
                 min_history=self.sentinel_min_history,
                 max_rollbacks=self.max_rollbacks)
+        # step-time breakdown probe: data-wait vs dispatch vs device,
+        # syncing at most once per telemetry_sync_interval steps (same
+        # amortization as sentinel_interval); verdict joins the round log
+        probe = (self.telemetry.make_probe()
+                 if self.telemetry_cfg.steptime and not self.test_io
+                 else None)
+        self._steptime_probe = probe
+        profiler = self.telemetry.profiler
         chain = self.train_chain if self.train_chain > 1 else 0
         if chain and (tr.mesh.pipeline_parallel > 1
                       or (tr.update_period > 1
@@ -435,6 +485,8 @@ class LearnTask:
             # one dispatch (the H2D overlap comes from the chain itself)
             batches = (itr_train if (self.test_io or chain)
                        else tr.prefetch_device(itr_train))
+            if not self.test_io:
+                batches = self._timed_batches(batches, probe)
             pending = []
             pending_rows = 0
             for batch in batches:
@@ -459,13 +511,31 @@ class LearnTask:
                     # progress accounting covers DISPATCHED work only —
                     # queued-but-untrained batches must not inflate
                     # images/sec or read a stale/absent loss
+                    if profiler is not None:
+                        profiler.maybe_start(tr._step_count)
+                    t_d = time.perf_counter()
                     losses = tr.update_chain_batches(pending)
+                    if probe is not None:
+                        probe.record_step(time.perf_counter() - t_d,
+                                          ready=losses,
+                                          steps=len(pending))
+                    if profiler is not None:
+                        profiler.maybe_stop(tr._step_count, ready=losses)
                     batch_count += len(pending)
                     n_images += pending_rows
                     pending, pending_rows = [], 0
                     self._sentinel_step(tr, r, losses=losses)
                 else:
+                    if profiler is not None:
+                        profiler.maybe_start(tr._step_count)
+                    t_d = time.perf_counter()
                     tr.update(batch)
+                    if probe is not None:
+                        probe.record_step(time.perf_counter() - t_d,
+                                          ready=tr.last_loss_handle)
+                    if profiler is not None:
+                        profiler.maybe_stop(tr._step_count,
+                                            ready=tr.last_loss_handle)
                     n_images += real_rows
                     batch_count += 1
                     self._sentinel_step(tr, r)
@@ -479,7 +549,11 @@ class LearnTask:
                           f"elapsed, loss={tr.last_loss:.6f}, "
                           f"{ips:.1f} images/sec", flush=True)
             for b in pending:      # epoch tail shorter than the chain
+                t_d = time.perf_counter()
                 tr.update(b)
+                if probe is not None:
+                    probe.record_step(time.perf_counter() - t_d,
+                                      ready=tr.last_loss_handle)
                 n_images += b.batch_size - b.num_batch_padd
                 batch_count += 1
                 self._sentinel_step(tr, r)
@@ -494,6 +568,9 @@ class LearnTask:
                 line += tr.train_metric_report("train")
             for name, itr in evals:
                 line += tr.evaluate(itr, name)
+            if probe is not None:
+                # step-time breakdown + input-/compute-bound verdict
+                line += probe.report_fragment()
             # the metric line always prints on the root rank, even under
             # silent=1 (reference emits it via TrackerPrint regardless)
             if self._is_root:

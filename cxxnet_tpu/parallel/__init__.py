@@ -1,5 +1,6 @@
+from .compat import shard_map
 from .mesh import (MeshContext, allreduce_metric_pairs, make_mesh_context,
                    maybe_distributed_init, parse_device_spec)
 
 __all__ = ["MeshContext", "make_mesh_context", "parse_device_spec",
-           "maybe_distributed_init", "allreduce_metric_pairs"]
+           "maybe_distributed_init", "allreduce_metric_pairs", "shard_map"]

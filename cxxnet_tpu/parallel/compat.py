@@ -1,0 +1,81 @@
+"""JAX version-compatibility shims for the parallel layer.
+
+One seam instead of nine scattered try/excepts: every shard_map call in
+this codebase (trainer steps, ring attention, the pipeline schedule,
+tests) goes through :func:`shard_map` below, so supporting a new JAX
+spelling is a one-file change.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def force_cpu_devices(n: int) -> None:
+    """Pin the CPU backend with ``n`` virtual devices, across JAX
+    versions: newer JAX has the ``jax_num_cpu_devices`` config option;
+    0.4.x needs ``XLA_FLAGS=--xla_force_host_platform_device_count``,
+    which is read at BACKEND initialization (the first devices()
+    query), so setting it post-import still works as long as nothing
+    has initialized the backend yet. Shared by the multi-machine
+    worker scripts (tests/conftest.py keeps its own copy because it
+    must run before this package imports)."""
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", int(n))
+    except AttributeError:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
+
+
+def shard_map(f, mesh, in_specs, out_specs, axis_names=None):
+    """``jax.shard_map`` across JAX versions.
+
+    Newer JAX exposes top-level ``jax.shard_map`` whose partial-manual
+    mapping is spelled ``axis_names={...}`` (the named axes go manual,
+    the rest stay automatic/GSPMD). 0.4.x only has
+    ``jax.experimental.shard_map.shard_map``, where the same thing is
+    spelled as the COMPLEMENT set ``auto={...}``; its replication
+    checker predates both ``auto`` and the custom_vjp rules the
+    pipeline schedule needs, so ``check_rep`` is disabled on that path
+    (a static check only — numerics are identical).
+    """
+    if hasattr(jax, "shard_map"):
+        kw = {}
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _sm
+    kw = {}
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        # size-1 "auto" axes are semantically manual-equivalent (every
+        # collective over them is the identity) — fold them into the
+        # manual set instead of using 0.4.x's auto=, whose transpose
+        # rules miscompute gradients there (observed: sp train steps
+        # diverge from the GSPMD path). Only a GENUINE auto axis
+        # (size > 1, e.g. sp x tp composition) takes the auto= path,
+        # with its 0.4.x limitations.
+        auto = frozenset(a for a in auto if mesh.shape[a] > 1)
+        if auto:
+            kw["auto"] = auto
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False, **kw)
+
+
+# Trainer step bodies differentiate INSIDE the shard_map body and rely on
+# replication-tracking AD (newer JAX's check_vma, on by default) to psum
+# each shard's partial gradient into the global one. 0.4.x's shard_map
+# only has that machinery under check_rep=True, whose static out_specs
+# checker rejects these bodies — so there the step bodies must psum the
+# grads EXPLICITLY over their manual batch axes (each shard's grad there
+# is the full gradient of its LOCAL loss term, so a pmean reconstructs
+# the global mean-loss gradient exactly; on newer JAX this flag is False
+# and no extra collective is inserted).
+GRADS_NEED_EXPLICIT_PSUM = not hasattr(jax, "shard_map")

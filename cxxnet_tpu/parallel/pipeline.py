@@ -26,6 +26,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .compat import shard_map
+
 # The pvary helpers below probe varying-manual-axes APIs (jax.typeof().vma,
 # lax.pcast(..., to="varying"), lax.pvary) behind broad except clauses, and
 # the deadlock-avoidance scheme in pipeline_apply_stages depends on those
@@ -401,7 +403,7 @@ def pipeline_sharded(mesh: Mesh, stage_fn, stage_params, x: jax.Array,
     ``pipe_axis``; x is replicated; returns the full-batch output."""
     pparam_spec = jax.tree_util.tree_map(
         lambda _: P(pipe_axis), stage_params)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(pipeline_apply, stage_fn, axis_name=pipe_axis,
                           n_microbatch=n_microbatch),
         mesh=mesh,

@@ -12,10 +12,13 @@ Four pieces, each usable alone:
 * :mod:`.breaker` — :class:`CircuitBreaker` for the serve dispatch path
   (fail-fast 503s with a half-open recovery probe).
 
-Plus a tiny process-wide ``counters`` registry (below) that ties them
+Plus the process-wide ``counters`` ledger (below) that ties them
 together for observability: recordio corruption skips, IO retries,
 checkpoint write failures and invalid-checkpoint skips all land here and
-surface through ``/healthz`` / ``/statz`` and the chaos smoke tool.
+surface through ``/healthz`` / ``/statz``, the chaos smoke tool — and,
+since the ledger is a view over :mod:`cxxnet_tpu.telemetry.registry`,
+through every ``/metrics`` scrape (dotted names map to
+``cxxnet_<name>_total`` Prometheus counters).
 """
 
 from __future__ import annotations
@@ -23,29 +26,57 @@ from __future__ import annotations
 import threading
 from typing import Dict
 
+from ..telemetry.registry import REGISTRY
+
+
+def _prom_name(dotted: str) -> str:
+    """``"ckpt.write_failures"`` -> ``"cxxnet_ckpt_write_failures_total"``
+    — the dotted ledger names kept for /statz back-compat, the sanitized
+    form for Prometheus exposition."""
+    return "cxxnet_" + dotted.replace(".", "_").replace("-", "_") \
+        + "_total"
+
 
 class Counters:
-    """Thread-safe named counters (process-wide degradation ledger)."""
+    """Thread-safe named counters (process-wide degradation ledger).
 
-    def __init__(self):
+    Storage lives in the telemetry registry — one ``cxxnet_*_total``
+    counter per dotted name — so this class keeps only the name mapping;
+    ``/statz`` and chaos assertions read the same numbers a ``/metrics``
+    scrape exports, with the exact dotted keys they always had."""
+
+    def __init__(self, registry=None):
         self._lock = threading.Lock()
-        self._c: Dict[str, int] = {}
+        self._reg = registry or REGISTRY
+        self._children: Dict[str, object] = {}
+
+    def _child(self, name: str):
+        with self._lock:
+            c = self._children.get(name)
+            if c is None:
+                c = self._reg.counter(
+                    _prom_name(name),
+                    help=f"cxxnet degradation counter {name}").labels()
+                self._children[name] = c
+            return c
 
     def inc(self, name: str, n: int = 1) -> None:
-        with self._lock:
-            self._c[name] = self._c.get(name, 0) + n
+        self._child(name).inc(n)
 
     def get(self, name: str) -> int:
-        with self._lock:
-            return self._c.get(name, 0)
+        return int(self._child(name).value)
 
     def snapshot(self) -> Dict[str, int]:
         with self._lock:
-            return dict(self._c)
+            items = list(self._children.items())
+        return {name: int(c.value) for name, c in items}
 
     def reset(self) -> None:
         with self._lock:
-            self._c.clear()
+            items = list(self._children.values())
+            self._children.clear()
+        for c in items:
+            c._reset()
 
 
 counters = Counters()

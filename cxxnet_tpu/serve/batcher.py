@@ -34,6 +34,8 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..resilience import CircuitBreaker, CircuitOpen
+from ..telemetry.registry import REGISTRY
+from ..telemetry.trace import TRACER
 from .engine import InferenceEngine
 from .stats import ServingStats
 
@@ -79,6 +81,14 @@ class MicroBatcher:
         self._q: "queue.Queue[_Request]" = queue.Queue()
         self._rows_lock = threading.Lock()
         self._queued_rows = 0
+        # admitted-but-undispatched rows, straight off the backpressure
+        # accounting (labeled like the ServingStats serve metrics;
+        # close() drops the series again)
+        self._g_depth_fam = REGISTRY.gauge(
+            "cxxnet_serve_queue_rows",
+            "Rows admitted to the micro-batcher but not yet dispatched",
+            labels=("engine",))
+        self._g_depth = self._g_depth_fam.labels(self.stats.instance)
         self._stop = threading.Event()
         self._drain = True
         self._thread = threading.Thread(target=self._worker, daemon=True,
@@ -126,6 +136,7 @@ class MicroBatcher:
                     f"serve queue full ({self._queued_rows} rows "
                     f">= {self.max_queue_rows})")
             self._queued_rows += rows.shape[0]
+            self._g_depth.set(self._queued_rows)
             self._q.put(req)
         return req.future
 
@@ -138,6 +149,7 @@ class MicroBatcher:
             self._stop.set()
         self._q.put(None)                 # wake the worker
         self._thread.join(timeout=timeout)
+        self._g_depth_fam.remove_labels(self.stats.instance)
 
     @property
     def queued_rows(self) -> int:
@@ -151,6 +163,7 @@ class MicroBatcher:
         n = sum(r.rows.shape[0] for r in reqs)
         with self._rows_lock:
             self._queued_rows -= n
+            self._g_depth.set(self._queued_rows)
 
     def _flush(self, reqs: List[_Request]) -> None:
         """Reject expired requests, then dispatch the group in chunks of
@@ -180,8 +193,16 @@ class MicroBatcher:
 
     def _dispatch(self, live: List[_Request]) -> None:
         """ONE device call for one chunk; scatter results to futures."""
-        rows = (live[0].rows if len(live) == 1
-                else np.concatenate([r.rows for r in live], axis=0))
+        # queue-wait: earliest member submit -> now, recorded with
+        # explicit begin/end so it lands on the worker's trace track
+        t_now = time.perf_counter()
+        TRACER.add_complete("serve.queue_wait",
+                            min(r.t_submit for r in live), t_now,
+                            cat="serve", args={"requests": len(live)})
+        with TRACER.span("serve.batch_assembly", cat="serve",
+                         args={"requests": len(live)}):
+            rows = (live[0].rows if len(live) == 1
+                    else np.concatenate([r.rows for r in live], axis=0))
         try:
             out = self.engine.run_padded(rows, live[0].kind, live[0].node)
         except Exception as e:

@@ -28,6 +28,7 @@ import numpy as np
 
 from ..config import ConfigPairs, parse_config_string, parse_policy
 from ..resilience import failpoints
+from ..telemetry.trace import TRACER
 from ..trainer import Trainer
 from .. import checkpoint as ckpt
 from .stats import ServingStats
@@ -267,10 +268,13 @@ class InferenceEngine:
                 f"run_padded: {n} rows exceed the largest bucket "
                 f"{bucket}; chunk to max_batch first")
         tr = self.trainer
-        fn = self._compiled(bucket, kind, node)
-        padded = self._pad(rows_nhwc, bucket)
-        data = tr.mesh.shard_batch(padded)
-        out = np.asarray(fn(tr.params, tr.net_state, data))
+        with TRACER.span("serve.infer", cat="serve",
+                         args={"rows": int(n), "bucket": int(bucket),
+                               "kind": kind}):
+            fn = self._compiled(bucket, kind, node)
+            padded = self._pad(rows_nhwc, bucket)
+            data = tr.mesh.shard_batch(padded)
+            out = np.asarray(fn(tr.params, tr.net_state, data))
         return out[:n]
 
     def _run(self, data, kind: str, node: Optional[str] = None

@@ -39,6 +39,8 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from ..resilience import CircuitBreaker, CircuitOpen, counters
+from ..telemetry import PROMETHEUS_CONTENT_TYPE, render_prometheus
+from ..telemetry.trace import TRACER
 from .batcher import Backpressure, DeadlineExceeded, MicroBatcher
 from .engine import InferenceEngine
 from .stats import ServingStats
@@ -72,6 +74,15 @@ def _make_handler(server: "ServeServer"):
                 self._reply(code, payload)
             elif self.path == "/statz":
                 self._reply(200, server.statz())
+            elif self.path == "/metrics":
+                # one scrape = the WHOLE process registry: serve,
+                # resilience, checkpoint, io — not just this server's
+                body = render_prometheus().encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self._reply(404, {"error": f"no such path {self.path}"})
 
@@ -85,6 +96,13 @@ def _make_handler(server: "ServeServer"):
             if self.path not in ("/predict", "/extract"):
                 self._reply(404, {"error": f"no such path {self.path}"})
                 return
+            # full request-lifecycle span (parse -> queue -> infer ->
+            # respond nest inside it on this handler thread's track)
+            with TRACER.span("serve.request", cat="serve",
+                             args={"path": self.path}):
+                self._handle_post()
+
+        def _handle_post(self):
             try:
                 req = self._read_json()
                 data = np.asarray(req["data"], np.float32)
@@ -98,15 +116,17 @@ def _make_handler(server: "ServeServer"):
                     fut = server.batcher.submit(data, "extract", node,
                                                 timeout_ms=timeout_ms)
                     out = fut.result(timeout=server.result_timeout_s)
-                    self._reply(200, {"node": node,
-                                      "features": out.tolist()})
+                    with TRACER.span("serve.respond", cat="serve"):
+                        self._reply(200, {"node": node,
+                                          "features": out.tolist()})
                 else:
                     kind = "raw" if int(req.get("raw", 0)) else "predict"
                     fut = server.batcher.submit(data, kind,
                                                 timeout_ms=timeout_ms)
                     out = fut.result(timeout=server.result_timeout_s)
                     key = "prob" if kind == "raw" else "pred"
-                    self._reply(200, {key: out.tolist()})
+                    with TRACER.span("serve.respond", cat="serve"):
+                        self._reply(200, {key: out.tolist()})
             except (Backpressure, CircuitOpen) as e:
                 self._reply(503, {"error": str(e)})
             except DeadlineExceeded as e:
@@ -239,6 +259,9 @@ class ServeServer:
         self.batcher.close(drain=True)
         if not self.silent:
             print(self.stats.log_line(), flush=True)
+        # drop this engine's per-instance series from the registry —
+        # a stopped server's frozen gauges must not be scraped forever
+        self.stats.unregister()
 
     def serve_until_interrupt(self) -> None:
         """Foreground loop for ``task = serve``: block until SIGINT/

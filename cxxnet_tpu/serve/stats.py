@@ -1,107 +1,227 @@
 """Serving observability: QPS, latency percentiles, batch fill, cache hits.
 
-The counters are the serving analog of the trainer's per-round metric line
-(trainer.py round metrics): everything lands in one dict snapshot
-(``/statz``) and one periodic one-line log. All methods are thread-safe —
-the batcher worker, HTTP handler threads, and the engine all write here.
+Since PR 4 this is a VIEW over the process-wide telemetry registry
+(:mod:`cxxnet_tpu.telemetry.registry`), not parallel bookkeeping: every
+counter here is a ``cxxnet_serve_*`` registry metric (labeled by engine
+instance, so several engines in one process stay distinguishable in a
+``/metrics`` scrape), and :meth:`snapshot` — the ``/statz`` payload —
+reads those same series back with its ORIGINAL key layout, so PR-1
+clients and smoke tools see byte-identical structure. Request latencies
+additionally feed a registry histogram
+(``cxxnet_serve_request_latency_seconds``); the exact p50/p95/p99 the
+snapshot reports still come from a bounded local reservoir (percentiles
+from log buckets would be quantized).
+
+All methods are thread-safe — the batcher worker, HTTP handler threads,
+and the engine all write here.
 """
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from collections import deque
 from typing import Dict, List, Optional
+
+from ..telemetry.registry import REGISTRY, MetricRegistry
+
+_INSTANCE_SEQ = itertools.count()
 
 
 class ServingStats:
     """Rolling serving metrics.
 
     * latency: bounded sample reservoir (last ``max_samples`` request
-      latencies) -> p50/p95/p99 at snapshot time;
+      latencies) -> p50/p95/p99 at snapshot time, plus the registry
+      latency histogram;
     * QPS: completion timestamps within a rolling ``qps_window_s`` window;
     * batch fill: real rows / padded bucket rows, per dispatch;
     * coalescing: requests folded into each device call;
     * compile cache: hit/miss/evict counters fed by the engine.
     """
 
-    def __init__(self, max_samples: int = 4096, qps_window_s: float = 60.0):
+    def __init__(self, max_samples: int = 4096, qps_window_s: float = 60.0,
+                 registry: Optional[MetricRegistry] = None):
         self._lock = threading.Lock()
         self._t0 = time.time()
         self.qps_window_s = qps_window_s
         self._lat: deque = deque(maxlen=max_samples)       # seconds
         self._done_ts: deque = deque(maxlen=65536)         # completion times
-        # request counters
-        self.requests_total = 0
-        self.requests_ok = 0
-        self.rejected_backpressure = 0
-        self.rejected_deadline = 0
-        self.rejected_breaker = 0
-        self.failed = 0
-        # batch counters
-        self.batches_dispatched = 0
-        self.rows_real = 0
-        self.rows_padded = 0          # bucket rows incl. padding
-        self.requests_batched = 0     # requests folded into dispatches
-        self.batches_coalesced_ge2 = 0
-        # compile cache counters
-        self.cache_hits = 0
-        self.cache_misses = 0
-        self.cache_evictions = 0
-        self.cache_size = 0
-        self.cache_capacity = 0
+        reg = registry or REGISTRY
+        self.instance = str(next(_INSTANCE_SEQ))
+        eng = (self.instance,)
+        # every (family, label-values) this instance creates, so
+        # unregister() can drop the series when the engine goes away —
+        # otherwise each dead instance's ~20 series (stale gauges
+        # included) would be scraped forever
+        self._series = []
+
+        def _track(fam, *vals):
+            self._series.append((fam, vals))
+            return fam.labels(*vals)
+        req = reg.counter("cxxnet_serve_requests_total",
+                          "Serve requests by outcome",
+                          labels=("engine", "result"))
+        self._c_total = _track(req, self.instance, "received")
+        self._c_ok = _track(req, self.instance, "ok")
+        self._c_rej_bp = _track(req, self.instance, "rejected_backpressure")
+        self._c_rej_dl = _track(req, self.instance, "rejected_deadline")
+        self._c_rej_br = _track(req, self.instance, "rejected_breaker")
+        self._c_failed = _track(req, self.instance, "failed")
+        self._c_batches = _track(reg.counter(
+            "cxxnet_serve_batches_dispatched_total",
+            "Device dispatches", labels=("engine",)), *eng)
+        self._c_req_batched = _track(reg.counter(
+            "cxxnet_serve_requests_batched_total",
+            "Requests folded into dispatches",
+            labels=("engine",)), *eng)
+        rows = reg.counter("cxxnet_serve_batch_rows_total",
+                           "Dispatched rows (real vs padded-bucket)",
+                           labels=("engine", "kind"))
+        self._c_rows_real = _track(rows, self.instance, "real")
+        self._c_rows_padded = _track(rows, self.instance, "padded")
+        self._c_coalesced = _track(reg.counter(
+            "cxxnet_serve_batches_coalesced_total",
+            "Dispatches that folded >= 2 requests",
+            labels=("engine",)), *eng)
+        cache = reg.counter("cxxnet_serve_cache_events_total",
+                            "Compile-cache events",
+                            labels=("engine", "event"))
+        self._c_hit = _track(cache, self.instance, "hit")
+        self._c_miss = _track(cache, self.instance, "miss")
+        self._c_evict = _track(cache, self.instance, "evict")
+        self._g_csize = _track(reg.gauge("cxxnet_serve_cache_size",
+                                         "Compiled executables cached",
+                                         labels=("engine",)), *eng)
+        self._g_ccap = _track(reg.gauge("cxxnet_serve_cache_capacity",
+                                        "Compile-cache capacity",
+                                        labels=("engine",)), *eng)
+        self._h_lat = _track(reg.histogram(
+            "cxxnet_serve_request_latency_seconds",
+            "End-to-end request latency (submit -> result)",
+            labels=("engine",)), *eng)
+
+    # -- registry-backed attribute views ---------------------------------
+    @property
+    def requests_total(self) -> int:
+        return int(self._c_total.value)
+
+    @property
+    def requests_ok(self) -> int:
+        return int(self._c_ok.value)
+
+    @property
+    def rejected_backpressure(self) -> int:
+        return int(self._c_rej_bp.value)
+
+    @property
+    def rejected_deadline(self) -> int:
+        return int(self._c_rej_dl.value)
+
+    @property
+    def rejected_breaker(self) -> int:
+        return int(self._c_rej_br.value)
+
+    @property
+    def failed(self) -> int:
+        return int(self._c_failed.value)
+
+    @property
+    def batches_dispatched(self) -> int:
+        return int(self._c_batches.value)
+
+    @property
+    def rows_real(self) -> int:
+        return int(self._c_rows_real.value)
+
+    @property
+    def rows_padded(self) -> int:
+        return int(self._c_rows_padded.value)
+
+    @property
+    def requests_batched(self) -> int:
+        return int(self._c_req_batched.value)
+
+    @property
+    def batches_coalesced_ge2(self) -> int:
+        return int(self._c_coalesced.value)
+
+    @property
+    def cache_hits(self) -> int:
+        return int(self._c_hit.value)
+
+    @property
+    def cache_misses(self) -> int:
+        return int(self._c_miss.value)
+
+    @property
+    def cache_evictions(self) -> int:
+        return int(self._c_evict.value)
+
+    @property
+    def cache_size(self) -> int:
+        return int(self._g_csize.value)
+
+    @property
+    def cache_capacity(self) -> int:
+        return int(self._g_ccap.value)
+
+    def unregister(self) -> None:
+        """Drop this instance's series from the registry (ServeServer.
+        stop() calls this): a torn-down engine's numbers — stale cache
+        gauges especially — must not appear in scrapes forever. Held
+        child references keep working; they just stop exporting."""
+        for fam, vals in self._series:
+            fam.remove_labels(*vals)
 
     # -- recording -------------------------------------------------------
     def record_request(self) -> None:
-        with self._lock:
-            self.requests_total += 1
+        self._c_total.inc()
 
     def record_reject(self, kind: str) -> None:
-        with self._lock:
-            if kind == "backpressure":
-                self.rejected_backpressure += 1
-            elif kind == "breaker":
-                self.rejected_breaker += 1
-            else:
-                self.rejected_deadline += 1
+        if kind == "backpressure":
+            self._c_rej_bp.inc()
+        elif kind == "breaker":
+            self._c_rej_br.inc()
+        else:
+            self._c_rej_dl.inc()
 
     def record_failure(self) -> None:
-        with self._lock:
-            self.failed += 1
+        self._c_failed.inc()
 
     def record_done(self, latency_s: float) -> None:
         now = time.time()
+        self._c_ok.inc()
+        self._h_lat.observe(latency_s)
         with self._lock:
-            self.requests_ok += 1
             self._lat.append(latency_s)
             self._done_ts.append(now)
 
     def record_batch(self, n_requests: int, rows_real: int,
                      rows_bucket: int) -> None:
-        with self._lock:
-            self.batches_dispatched += 1
-            self.requests_batched += n_requests
-            self.rows_real += rows_real
-            self.rows_padded += rows_bucket
-            if n_requests >= 2:
-                self.batches_coalesced_ge2 += 1
+        self._c_batches.inc()
+        self._c_req_batched.inc(n_requests)
+        self._c_rows_real.inc(rows_real)
+        self._c_rows_padded.inc(rows_bucket)
+        if n_requests >= 2:
+            self._c_coalesced.inc()
 
     def record_cache(self, hit: Optional[bool] = None,
                      size: Optional[int] = None,
                      capacity: Optional[int] = None,
                      evicted: bool = False) -> None:
         """``hit=None`` updates geometry only (no hit/miss tick)."""
-        with self._lock:
-            if hit is True:
-                self.cache_hits += 1
-            elif hit is False:
-                self.cache_misses += 1
-            if evicted:
-                self.cache_evictions += 1
-            if size is not None:
-                self.cache_size = size
-            if capacity is not None:
-                self.cache_capacity = capacity
+        if hit is True:
+            self._c_hit.inc()
+        elif hit is False:
+            self._c_miss.inc()
+        if evicted:
+            self._c_evict.inc()
+        if size is not None:
+            self._g_csize.set(size)
+        if capacity is not None:
+            self._g_ccap.set(capacity)
 
     # -- reading ---------------------------------------------------------
     @staticmethod
@@ -113,42 +233,33 @@ class ServingStats:
         return sorted_vals[idx]
 
     def snapshot(self) -> Dict:
-        """One coherent dict of everything — the ``/statz`` payload.
-        Only cheap copies happen under the lock; the deque scan and the
-        percentile sort run outside it so a monitoring poller never
-        stalls the dispatch hot path's record_* calls."""
+        """One coherent dict of everything — the ``/statz`` payload,
+        with the exact PR-1 key layout. Counter reads are individually
+        locked registry lookups; the deque copy happens under this
+        object's lock and the percentile sort outside it, so a
+        monitoring poller never stalls the dispatch hot path."""
+        now = time.time()
         with self._lock:
-            now = time.time()
             lat_raw = list(self._lat)
             done_ts = list(self._done_ts)
-            counters = (self.requests_total, self.requests_ok,
-                        self.rejected_backpressure, self.rejected_deadline,
-                        self.rejected_breaker,
-                        self.failed, self.batches_dispatched,
-                        self.requests_batched, self.rows_real,
-                        self.rows_padded, self.batches_coalesced_ge2,
-                        self.cache_hits, self.cache_misses,
-                        self.cache_evictions, self.cache_size,
-                        self.cache_capacity)
-        (req_total, req_ok, rej_bp, rej_dl, rej_br, failed, b_disp,
-         req_batched, rows_real, rows_padded, coalesced, c_hit, c_miss,
-         c_evict, c_size, c_cap) = counters
         uptime = max(now - self._t0, 1e-9)
         window = min(self.qps_window_s, uptime)
         cutoff = now - window
         recent = sum(1 for t in done_ts if t >= cutoff)
         lat = sorted(lat_raw)
+        rows_real, rows_padded = self.rows_real, self.rows_padded
+        b_disp, req_batched = self.batches_dispatched, self.requests_batched
         fill = rows_real / rows_padded if rows_padded else 0.0
         rpb = req_batched / b_disp if b_disp else 0.0
         return {
             "uptime_s": round(uptime, 3),
             "requests": {
-                "total": req_total,
-                "ok": req_ok,
-                "rejected_backpressure": rej_bp,
-                "rejected_deadline": rej_dl,
-                "rejected_breaker": rej_br,
-                "failed": failed,
+                "total": self.requests_total,
+                "ok": self.requests_ok,
+                "rejected_backpressure": self.rejected_backpressure,
+                "rejected_deadline": self.rejected_deadline,
+                "rejected_breaker": self.rejected_breaker,
+                "failed": self.failed,
             },
             "qps": round(recent / window, 3) if window else 0.0,
             "latency_ms": {
@@ -161,18 +272,18 @@ class ServingStats:
             },
             "batches": {
                 "dispatched": b_disp,
-                "coalesced_ge2": coalesced,
+                "coalesced_ge2": self.batches_coalesced_ge2,
                 "avg_requests_per_batch": round(rpb, 3),
                 "fill_ratio": round(fill, 4),
                 "rows_real": rows_real,
                 "rows_padded": rows_padded,
             },
             "compile_cache": {
-                "hits": c_hit,
-                "misses": c_miss,
-                "evictions": c_evict,
-                "size": c_size,
-                "capacity": c_cap,
+                "hits": self.cache_hits,
+                "misses": self.cache_misses,
+                "evictions": self.cache_evictions,
+                "size": self.cache_size,
+                "capacity": self.cache_capacity,
             },
         }
 

@@ -1,0 +1,99 @@
+"""cxxnet_tpu.telemetry — unified observability for training and serving.
+
+One registry, one tracer, every subsystem a client:
+
+* :mod:`.registry` — process-wide thread-safe Counter / Gauge /
+  log-bucketed Histogram registry (:data:`REGISTRY`). ``resilience.
+  counters``, ``serve.ServingStats``, the IO prefetch queue and the
+  checkpoint layer all store their numbers HERE; ``/statz`` and
+  ``/metrics`` are views of it.
+* :mod:`.trace` — bounded-ring span tracing (:data:`TRACER`), exported
+  as perfetto-loadable Chrome trace JSON via ``telemetry_trace=path``.
+* :mod:`.steptime` — :class:`StepTimeProbe`, the amortized-sync
+  data-wait / dispatch / device breakdown with the input-bound vs
+  compute-bound verdict in the round log.
+* :mod:`.exporter` — Prometheus text rendering, the standalone
+  ``telemetry_port`` scrape endpoint, and the ``telemetry_log`` JSONL
+  event log.
+* :mod:`.profiler` — ``telemetry_profile_steps=a-b`` jax.profiler
+  brackets.
+
+:class:`TelemetrySession` bundles the knob-driven pieces so the task
+driver (main.py) owns exactly one object with one ``close()``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .registry import REGISTRY, MetricRegistry, get_registry, log_buckets
+from .trace import TRACER, Tracer, get_tracer
+from .steptime import StepTimeProbe
+from .exporter import (PROMETHEUS_CONTENT_TYPE, MetricsServer,
+                       TelemetryLogger, render_prometheus)
+from .profiler import StepProfiler
+
+__all__ = [
+    "REGISTRY", "MetricRegistry", "get_registry", "log_buckets",
+    "TRACER", "Tracer", "get_tracer",
+    "StepTimeProbe", "StepProfiler",
+    "MetricsServer", "TelemetryLogger", "render_prometheus",
+    "PROMETHEUS_CONTENT_TYPE", "TelemetrySession",
+]
+
+
+class TelemetrySession:
+    """Everything the ``telemetry_*`` config knobs turn on, with one
+    close(). Built by main.py from a :class:`cxxnet_tpu.config.
+    TelemetryConfig`; every piece is optional and absent by default, so
+    an unconfigured run pays only the disabled-tracer attribute checks.
+    """
+
+    def __init__(self, cfg, silent: bool = False):
+        self.cfg = cfg
+        self.silent = silent
+        self.logger: Optional[TelemetryLogger] = None
+        self.server: Optional[MetricsServer] = None
+        self.profiler: Optional[StepProfiler] = None
+        if cfg.trace_path:
+            TRACER.enable(capacity=cfg.trace_capacity)
+        if cfg.log_path:
+            self.logger = TelemetryLogger(
+                cfg.log_path, interval_s=cfg.log_interval_s,
+                max_bytes=cfg.log_max_kb << 10).start()
+        if cfg.port:
+            try:
+                self.server = MetricsServer(port=cfg.port).start()
+            except OSError as e:
+                # telemetry must never kill the run: a taken port (e.g.
+                # several ranks sharing a host) degrades to no endpoint
+                print(f"WARNING: telemetry_port {cfg.port} unavailable "
+                      f"({e}); /metrics endpoint disabled", flush=True)
+            else:
+                if not silent:
+                    print(f"telemetry: /metrics on "
+                          f"http://127.0.0.1:{self.server.port}",
+                          flush=True)
+        if cfg.profile_steps:
+            self.profiler = StepProfiler(cfg.profile_steps,
+                                         cfg.profile_dir)
+
+    def make_probe(self) -> StepTimeProbe:
+        return StepTimeProbe(sync_interval=self.cfg.sync_interval)
+
+    def close(self, ready=None) -> None:
+        """Finalize in dependency order: close a live profiler bracket,
+        flush the JSONL log, dump the trace, stop the scrape server."""
+        if self.profiler is not None:
+            self.profiler.close(ready)
+        if self.logger is not None:
+            self.logger.stop()
+        if self.cfg.trace_path:
+            n = TRACER.dump(self.cfg.trace_path)
+            if not self.silent:
+                print(f"telemetry: {n} trace events -> "
+                      f"{self.cfg.trace_path}"
+                      + (f" ({TRACER.dropped} dropped)"
+                         if TRACER.dropped else ""), flush=True)
+        if self.server is not None:
+            self.server.stop()

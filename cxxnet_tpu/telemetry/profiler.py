@@ -1,0 +1,84 @@
+"""jax.profiler step brackets: ``telemetry_profile_steps=a-b``.
+
+The round-granular ``profile_dir`` knob (PR 0) traces the WHOLE loop —
+gigabytes on a long run and useless for isolating one steady-state step.
+This brackets exactly the global steps ``a..b`` (inclusive) with
+``jax.profiler.start_trace``/``stop_trace`` into a dump directory, and
+blocks on the last bracketed step's output before stopping so the
+device-side activity of step ``b`` actually lands in the dump.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Optional, Tuple
+
+from .trace import TRACER
+
+_RANGE_RE = re.compile(r"^\s*(\d+)\s*-\s*(\d+)\s*$")
+
+
+def parse_step_range(spec: str) -> Tuple[int, int]:
+    """``"a-b"`` -> (a, b) with 0 <= a <= b; a bare ``"n"`` means one
+    step (n, n)."""
+    spec = spec.strip()
+    m = _RANGE_RE.match(spec)
+    if m:
+        a, b = int(m.group(1)), int(m.group(2))
+    elif spec.isdigit():
+        a = b = int(spec)
+    else:
+        raise ValueError(
+            f"telemetry_profile_steps must be 'a-b' or 'n', got {spec!r}")
+    if a > b:
+        raise ValueError(
+            f"telemetry_profile_steps: start {a} > stop {b}")
+    return a, b
+
+
+class StepProfiler:
+    """Drive from the train loop: ``maybe_start(step)`` before the
+    dispatch of global step ``step``, ``maybe_stop(step_after, ready)``
+    after it (with the count already advanced). Idempotent and safe to
+    leave in the loop — outside the bracket both calls are integer
+    compares. ``close()`` finalizes a bracket the loop never exited
+    (e.g. the run ended inside it)."""
+
+    def __init__(self, spec: str, dump_dir: str):
+        self.start_step, self.stop_step = parse_step_range(spec)
+        self.dump_dir = dump_dir
+        self.active = False
+        self.done = False
+
+    def maybe_start(self, step: int) -> None:
+        if self.done or self.active or step < self.start_step:
+            return
+        import jax
+        jax.profiler.start_trace(self.dump_dir)
+        self.active = True
+        TRACER.instant("profiler.start_trace", cat="profile",
+                       args={"step": step, "dir": self.dump_dir})
+
+    def maybe_stop(self, next_step: int, ready: Any = None) -> None:
+        """``next_step`` is the step count AFTER the last dispatch; the
+        bracket closes once it passes ``stop_step``."""
+        if not self.active or next_step <= self.stop_step:
+            return
+        self._stop(ready)
+
+    def _stop(self, ready: Any = None) -> None:
+        import jax
+        if ready is not None:
+            try:
+                jax.block_until_ready(ready)
+            except Exception:
+                pass
+        jax.profiler.stop_trace()
+        self.active = False
+        self.done = True
+        TRACER.instant("profiler.stop_trace", cat="profile",
+                       args={"dir": self.dump_dir})
+
+    def close(self, ready: Any = None) -> None:
+        if self.active:
+            self._stop(ready)

@@ -1,0 +1,209 @@
+"""Lightweight span tracing over monotonic clocks, Chrome-trace export.
+
+Dapper-style spans for the two pipelines this trainer runs — the
+training step (data-wait -> host->device stage -> dispatch -> device
+block -> eval -> checkpoint) and the serve request lifecycle
+(queue-wait -> batch-assembly -> infer -> respond) — recorded into a
+bounded ring buffer and exported as Chrome trace-event JSON
+(``{"traceEvents": [...]}``), loadable in Perfetto / chrome://tracing.
+
+Design constraints, in order:
+
+* **disabled is free**: every instrumentation point costs one attribute
+  read and a truthiness check when tracing is off (``span`` returns a
+  shared no-op context manager); production code can therefore bracket
+  hot paths unconditionally;
+* **bounded**: the ring keeps the newest ``capacity`` events and counts
+  what it dropped — a week-long run with tracing left on degrades to "the
+  last N events", never to an OOM;
+* **timeline-coherent**: all timestamps come from ``time.perf_counter()``
+  (monotonic), so spans recorded from explicit begin/end pairs (e.g. the
+  batcher's queue-wait, whose start is a request's submit time on another
+  thread) land on the same timeline as context-manager spans.
+
+Threading: events carry the recording thread's id, so nested spans on one
+thread render as a flame stack and concurrent threads as parallel tracks
+— exactly the Chrome trace-event "X" (complete-event) semantics.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+
+class _NullSpan:
+    """Shared no-op context manager — the disabled-tracer fast path."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_tracer", "name", "cat", "args", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str,
+                 args: Optional[Dict[str, Any]]):
+        self._tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.args = args
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._tracer.add_complete(self.name, self._t0,
+                                  time.perf_counter(),
+                                  cat=self.cat, args=self.args)
+        return False
+
+
+class Tracer:
+    """Bounded ring buffer of Chrome trace events; one process-global
+    instance at :data:`TRACER`. ``enable()`` turns recording on (the
+    ``telemetry_trace=path`` knob does this via main.py); every
+    ``span``/``add_complete``/``instant`` call before that is a no-op."""
+
+    def __init__(self, capacity: int = 65536):
+        self._lock = threading.Lock()
+        self._buf: deque = deque(maxlen=capacity)
+        self._enabled = False
+        self._t0 = time.perf_counter()
+        self.dropped = 0
+        self._thread_names: Dict[int, str] = {}
+
+    # -- lifecycle -------------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def enable(self, capacity: Optional[int] = None) -> None:
+        with self._lock:
+            if capacity is not None and capacity != self._buf.maxlen:
+                self._buf = deque(self._buf, maxlen=int(capacity))
+            self._enabled = True
+
+    def disable(self) -> None:
+        self._enabled = False
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+            self._thread_names.clear()
+            self.dropped = 0
+            self._t0 = time.perf_counter()
+
+    # -- recording -------------------------------------------------------
+    def span(self, name: str, cat: str = "",
+             args: Optional[Dict[str, Any]] = None):
+        """``with tracer.span("serve.infer", args={...}):`` — records one
+        complete ("X") event on exit. Free when disabled."""
+        if not self._enabled:
+            return _NULL_SPAN
+        return _Span(self, name, cat, args)
+
+    def add_complete(self, name: str, t0: float, t1: float,
+                     cat: str = "", args: Optional[Dict[str, Any]] = None,
+                     tid: Optional[int] = None) -> None:
+        """Record a span from explicit ``time.perf_counter()`` begin/end
+        values — for durations measured across threads (queue wait) or
+        already measured before the tracer is consulted."""
+        if not self._enabled:
+            return
+        ev = {
+            "name": name,
+            "ph": "X",
+            "ts": (t0 - self._t0) * 1e6,            # microseconds
+            "dur": max(t1 - t0, 0.0) * 1e6,
+            "pid": os.getpid(),
+            "tid": tid if tid is not None else threading.get_ident(),
+        }
+        if cat:
+            ev["cat"] = cat
+        if args:
+            ev["args"] = args
+        self._push(ev)
+
+    def instant(self, name: str, cat: str = "",
+                args: Optional[Dict[str, Any]] = None) -> None:
+        """A zero-duration marker (Chrome "i" event) — rollbacks,
+        breaker trips, profile start/stop."""
+        if not self._enabled:
+            return
+        ev = {
+            "name": name,
+            "ph": "i",
+            "s": "t",                                # thread-scoped
+            "ts": (time.perf_counter() - self._t0) * 1e6,
+            "pid": os.getpid(),
+            "tid": threading.get_ident(),
+        }
+        if cat:
+            ev["cat"] = cat
+        if args:
+            ev["args"] = args
+        self._push(ev)
+
+    def _push(self, ev: Dict[str, Any]) -> None:
+        t = threading.current_thread()
+        with self._lock:
+            if t.ident is not None and t.ident not in self._thread_names:
+                self._thread_names[t.ident] = t.name
+            if len(self._buf) == self._buf.maxlen:
+                self.dropped += 1
+            self._buf.append(ev)
+
+    # -- reading / export ------------------------------------------------
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._buf)
+
+    def dump(self, path: str) -> int:
+        """Write the ring as Chrome trace-event JSON (perfetto-loadable);
+        returns the event count. Thread-name metadata events are included
+        so tracks carry readable names instead of bare tids."""
+        with self._lock:
+            events = list(self._buf)
+            names = dict(self._thread_names)
+            dropped = self.dropped
+        meta = [{"name": "thread_name", "ph": "M", "pid": os.getpid(),
+                 "tid": tid, "args": {"name": name}}
+                for tid, name in sorted(names.items())]
+        doc = {
+            "traceEvents": meta + events,
+            "displayTimeUnit": "ms",
+            "otherData": {"dropped_events": dropped,
+                          "producer": "cxxnet_tpu.telemetry"},
+        }
+        from ..io import stream
+        payload = json.dumps(doc).encode("utf-8")
+        if stream.is_remote(path):
+            stream.write_bytes_atomic(path, payload)
+        else:
+            d = os.path.dirname(os.path.abspath(path))
+            if d:
+                os.makedirs(d, exist_ok=True)
+            with open(path, "wb") as f:
+                f.write(payload)
+        return len(events)
+
+
+# the process-global tracer every instrumentation point consults
+TRACER = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return TRACER

@@ -21,6 +21,7 @@ set/get_weight.
 from __future__ import annotations
 
 import re
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -32,9 +33,11 @@ from .graph import build_graph, global_param
 from .metrics import MetricSet
 from .model import Network
 from .optim import create_optimizer
-from .parallel import MeshContext, make_mesh_context
+from .parallel import MeshContext, make_mesh_context, shard_map
+from .parallel.compat import GRADS_NEED_EXPLICIT_PSUM
 from .io.data import DataBatch
 from .resilience import failpoints
+from .telemetry.trace import TRACER
 from . import checkpoint as ckpt
 
 _METRIC_RE = re.compile(r"^metric(?:\[([^,\]]+)(?:,([^\]]+))?\])?$")
@@ -628,6 +631,15 @@ class Trainer:
                 return loss, (res.state, _collect_nodes(res, needed))
             (loss, (new_state, nodes)), grads = _scaled_value_and_grad(
                 loss_fn, params, opt_state)
+            if GRADS_NEED_EXPLICIT_PSUM:
+                # pre-check_vma JAX: each shard's grad here is the FULL
+                # gradient of its LOCAL loss term (the pmean transposes
+                # to a plain broadcast without replication-tracking AD)
+                # — pmean them so every shard applies the exact global
+                # mean-loss gradient (see parallel/compat.py)
+                grads = jax.tree_util.tree_map(
+                    lambda g: jax.lax.pmean(g, (data_axis, seq_axis)),
+                    grads)
             # layer state computed from local shards (e.g. the MoE
             # load-balance aux loss) must leave the shard_map replicated
             new_state = jax.tree_util.tree_map(
@@ -676,7 +688,7 @@ class Trainer:
             chain_nodes_spec = ({k: P(None, data_axis, seq_axis,
                                       None, None)
                                  for k in [_TOP] + needed} if bank else {})
-            wrapped = jax.shard_map(
+            wrapped = shard_map(
                 step, mesh=self.mesh.mesh,
                 in_specs=(rep, rep, rep,
                           P(None, data_axis, None, None, seq_axis),
@@ -686,14 +698,14 @@ class Trainer:
                 out_specs=(rep, rep, rep, rep, chain_nodes_spec, rep),
                 axis_names={data_axis, seq_axis})
         elif chain:
-            wrapped = jax.shard_map(
+            wrapped = shard_map(
                 step, mesh=self.mesh.mesh,
                 in_specs=(rep, rep, rep, data_spec, lspec,
                           P(data_axis), rep, rep),
                 out_specs=(rep, rep, rep, rep, rep),
                 axis_names={data_axis, seq_axis})
         else:
-            wrapped = jax.shard_map(
+            wrapped = shard_map(
                 step, mesh=self.mesh.mesh,
                 in_specs=(rep, rep, rep, rep, data_spec, lspec,
                           P(data_axis), rep, rep),
@@ -1215,7 +1227,7 @@ class Trainer:
             lspec = P(data_axis)
             axes = {data_axis, pipe_axis, model_axis}
         if chain:
-            wrapped = jax.shard_map(
+            wrapped = shard_map(
                 step, mesh=self.mesh.mesh,
                 in_specs=(pspecs, opt_pspecs, rep, ds, lspec,
                           P(data_axis), rep, rep),
@@ -1227,7 +1239,7 @@ class Trainer:
             if name == top_name:
                 nodes_spec[name] = nodes_spec[_TOP]
         accum_spec = pspecs if period > 1 else rep
-        wrapped = jax.shard_map(
+        wrapped = shard_map(
             step, mesh=self.mesh.mesh,
             in_specs=(pspecs, opt_pspecs, rep, accum_spec, ds,
                       lspec, P(data_axis), rep, rep),
@@ -1282,7 +1294,7 @@ class Trainer:
         for name in wanted:
             if name == top_name:
                 nodes_spec[name] = nodes_spec[_TOP]
-        wrapped = jax.shard_map(step, mesh=self.mesh.mesh,
+        wrapped = shard_map(step, mesh=self.mesh.mesh,
                                 in_specs=(pspecs, P(), ds),
                                 out_specs=nodes_spec,
                                 axis_names=axes)
@@ -1624,6 +1636,16 @@ class Trainer:
 
     def stage_batch(self, batch: DataBatch, for_eval: bool = False
                     ) -> DataBatch:
+        """Traced wrapper over :meth:`_stage_batch` — the host->device
+        transfer span ("train.h2d_stage"; dispatch-side duration, the
+        copies themselves are async). Free when tracing is off."""
+        if not TRACER.enabled:
+            return self._stage_batch(batch, for_eval)
+        with TRACER.span("train.h2d_stage", cat="train"):
+            return self._stage_batch(batch, for_eval)
+
+    def _stage_batch(self, batch: DataBatch, for_eval: bool = False
+                     ) -> DataBatch:
         """Asynchronously place a host batch on the mesh: shard + deferred
         uint8 normalize, all dispatched without blocking (jax.device_put
         and jitted calls return futures). Staging batch N+1 while step N
@@ -1697,6 +1719,7 @@ class Trainer:
         (nnet_impl-inl.hpp:157-202). ``batch`` may be a host batch or one
         staged by ``stage_batch``/``prefetch_device``."""
         assert self.params is not None, "call init_model() first"
+        t_dispatch0 = time.perf_counter()
         do_update = (self.sample_counter + 1) % self.update_period == 0 \
             if self.update_period > 1 else True
         step = self._get_train_step(do_update, batch)
@@ -1742,6 +1765,11 @@ class Trainer:
         if self.sample_counter >= self.update_period:
             self.sample_counter = 0
             self.epoch_counter += 1
+        # dispatch-side span only: the step RUNS asynchronously; the
+        # device-time share is the step-time probe's job (steptime.py)
+        TRACER.add_complete("train.step_dispatch", t_dispatch0,
+                            time.perf_counter(), cat="train",
+                            args={"step": self._step_count})
         if self.eval_train:
             self._drain_pending_metric()
             self._pending_metric = (nodes, batch)
@@ -1870,7 +1898,7 @@ class Trainer:
             return _collect_nodes(res, needed)
 
         node_spec = P(data_axis, seq_axis, None, None)
-        wrapped = jax.shard_map(
+        wrapped = shard_map(
             step, mesh=self.mesh.mesh,
             in_specs=(P(), P(), P(data_axis, None, None, seq_axis)),
             out_specs={k: node_spec for k in [_TOP] + needed},
@@ -1917,9 +1945,10 @@ class Trainer:
         self.metric.clear()
         # prefetch: batch N+1's H2D overlaps batch N's host-side metric
         # accumulation (_eval_nodes is a no-op re-stage for staged batches)
-        for batch in self.prefetch_device(data_iter, for_eval=True):
-            nodes = self._eval_nodes(batch)
-            self._add_metric(self.metric, nodes, batch)
+        with TRACER.span("train.eval", cat="train", args={"set": name}):
+            for batch in self.prefetch_device(data_iter, for_eval=True):
+                nodes = self._eval_nodes(batch)
+                self._add_metric(self.metric, nodes, batch)
         if jax.process_count() > 1:
             self.metric.set_pairs(allreduce_metric_pairs(self.metric.pairs()))
         out = ""
@@ -1994,6 +2023,13 @@ class Trainer:
     @property
     def last_loss(self) -> float:
         return float(self._last_loss) if self._last_loss is not None else float("nan")
+
+    @property
+    def last_loss_handle(self):
+        """The last dispatched step's loss as a DEVICE value (or None) —
+        a ready-future for telemetry probes that must choose when to
+        sync, unlike :attr:`last_loss` which blocks immediately."""
+        return self._last_loss
 
     def params_finite(self) -> bool:
         """Device-side finiteness probe over the param masters (one tiny

@@ -22,8 +22,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
 n_cpu = int(os.environ.get("CXXNET_CPU_DEVICES", "0"))
 if n_cpu:
     import jax
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", n_cpu)
+    from cxxnet_tpu.parallel.compat import force_cpu_devices
+    force_cpu_devices(n_cpu)
 
 from cxxnet_tpu.main import main
 

@@ -10,6 +10,7 @@ import pytest
 
 from cxxnet_tpu.ops import (attention_reference, chunked_attention,
                             flash_attention)
+from cxxnet_tpu.parallel import shard_map
 from cxxnet_tpu.parallel.ring import ring_attention_sharded
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -123,7 +124,7 @@ def test_gather_kv_attention_matches_reference(causal):
     q, k, v = _qkv(s=128)
 
     def sharded(q, k, v):
-        f = jax.shard_map(
+        f = shard_map(
             lambda a, b, c: gather_kv_attention(a, b, c, "seq",
                                                 causal=causal),
             mesh=mesh,

@@ -154,6 +154,10 @@ def test_sp_with_moe_state():
     assert np.isfinite(tr.last_loss) and 0.0 < aux < 0.2
 
 
+# KNOWN-FAIL on jax 0.4.x: sp x tp needs GSPMD-auto param sharding INSIDE
+# the manual shard_map (auto=), which that version lowers to a PartitionId
+# op its SPMD partitioner rejects ("PartitionId instruction is not
+# supported"); passes on the validated jax 0.9-0.10.
 def test_sp_composes_with_tp():
     """seq_parallel x model_parallel: the partial-manual shard_map leaves
     the 'model' axis to GSPMD, so TP param shardings (mha heads, MoE

@@ -312,6 +312,9 @@ def test_allreduce_pairs_single_process_identity():
     assert allreduce_metric_pairs(pairs) == pairs
 
 
+# KNOWN-FAIL on jax 0.4.x: cross-process collectives on the CPU backend
+# raise "Multiprocess computations aren't implemented on the CPU backend";
+# passes on newer jax where the CPU backend gained cross-host support.
 def test_two_process_distributed_training(tmp_path):
     """Real multi-process jax.distributed run (the ps-lite local-mode
     analog): 2 workers x 2 virtual CPU devices form one 4-device
@@ -334,6 +337,8 @@ def test_two_process_distributed_training(tmp_path):
                   if f.endswith(".model")) == ["0000.model", "0001.model"]
 
 
+# KNOWN-FAIL on jax 0.4.x: same CPU-backend multiprocess limitation as
+# test_two_process_distributed_training above.
 def test_two_process_ring_attention(tmp_path):
     """Sequence parallelism across process boundaries: the 'seq' mesh axis
     spans 2 processes x 2 devices; ppermute carries k/v shards over the

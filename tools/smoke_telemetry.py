@@ -1,0 +1,205 @@
+#!/usr/bin/env python
+"""Telemetry smoke check (tier-1-adjacent; CPU-safe, deterministic).
+
+Drives cxxnet_tpu.telemetry end-to-end — the PR-4 acceptance run:
+
+  1. TRAIN with tracing + JSONL + step-time probe on: asserts the
+     Chrome trace is valid JSON with every train-lifecycle span
+     (data-wait, host->device stage, step dispatch, device block, eval,
+     checkpoint save), the probe added no per-step host sync (blocking
+     syncs <= steps / telemetry_sync_interval), the round log carried a
+     data/dispatch/device breakdown + bound verdict, and the JSONL log
+     rotated under a tiny size cap.
+  2. SERVE a few mixed requests with tracing on: asserts the full
+     request lifecycle (request -> queue-wait -> batch-assembly ->
+     infer -> respond) appears in the trace, and that ONE /metrics
+     scrape of the serve server parses as Prometheus text exposing
+     serve, resilience/checkpoint, steptime, and io metrics together.
+
+Exits nonzero on any failure.  Run:  JAX_PLATFORMS=cpu python tools/smoke_telemetry.py
+(sibling of tools/smoke_serve.py / smoke_bf16.py / chaos_train.py)
+"""
+
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+NET_CFG = """
+netconfig=start
+layer[+1:h1] = fullc:fc1
+  nhidden = 32
+  random_type = xavier
+layer[+1:a1] = relu
+layer[a1->out] = fullc:fc2
+  nhidden = 5
+  random_type = xavier
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,16
+batch_size = 64
+eta = 0.3
+dev = cpu
+eval_train = 0
+print_step = 0
+silent = 1
+save_period = 1
+metric = error
+"""
+
+BASE_CFG = """
+data = train
+iter = synthetic
+  num_inst = 512
+  num_class = 5
+  input_shape = 1,1,16
+  seed_data = 3
+iter = end
+eval = test
+iter = synthetic
+  num_inst = 128
+  num_class = 5
+  input_shape = 1,1,16
+  seed_data = 9
+iter = end
+""" + NET_CFG
+
+TRAIN_SPANS = ("train.data_wait", "train.h2d_stage", "train.step_dispatch",
+               "train.device_block", "train.eval", "ckpt.save")
+SERVE_SPANS = ("serve.request", "serve.queue_wait", "serve.batch_assembly",
+               "serve.infer", "serve.respond")
+
+
+def parse_prometheus(text):
+    """Every non-comment line must parse as ``name{labels} value``."""
+    out = {}
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            continue
+        key, _, val = line.rpartition(" ")
+        assert key, f"malformed exposition line: {line!r}"
+        out[key] = float(val)
+    return out
+
+
+def main() -> int:
+    import numpy as np
+    from cxxnet_tpu.config import parse_config_string
+    from cxxnet_tpu.main import LearnTask
+    from cxxnet_tpu.telemetry import REGISTRY
+    from cxxnet_tpu.telemetry.trace import TRACER
+
+    td = tempfile.mkdtemp(prefix="smoke_telemetry_")
+    trace_path = os.path.join(td, "trace.json")
+    log_path = os.path.join(td, "tel.jsonl")
+    sync_interval = 4
+
+    # ---- phase 1: traced train run with the step-time probe -------------
+    task = LearnTask(parse_config_string(
+        BASE_CFG
+        + f"model_dir = {os.path.join(td, 'models')}\n"
+        + "num_round = 3\n"
+        + f"telemetry_trace = {trace_path}\n"
+        + f"telemetry_log = {log_path}\n"
+        + "telemetry_log_interval = 0.02\n"
+        + "telemetry_log_max_kb = 1\n"
+        + f"telemetry_sync_interval = {sync_interval}\n"))
+    task.run()
+    probe = task._steptime_probe
+    assert probe is not None and probe.steps >= 8, \
+        f"probe saw too few steps: {probe and probe.steps}"
+    # THE no-per-step-host-sync contract: <= 1 blocking sync per
+    # telemetry_sync_interval steps (+1 for any forced final window)
+    budget = probe.steps // sync_interval + 1
+    assert 1 <= probe.syncs <= budget, \
+        f"probe synced {probe.syncs}x in {probe.steps} steps " \
+        f"(interval {sync_interval}, budget {budget})"
+    frag = probe.report_fragment()
+    assert "bound:" in frag and "device_ms:" in frag, \
+        f"round-log fragment incomplete: {frag!r}"
+
+    doc = json.load(open(trace_path))
+    spans = {}
+    for ev in doc["traceEvents"]:
+        if ev.get("ph") == "X":
+            spans[ev["name"]] = spans.get(ev["name"], 0) + 1
+    for name in TRAIN_SPANS:
+        assert spans.get(name, 0) >= 1, \
+            f"train span {name!r} missing from trace: {sorted(spans)}"
+    # perfetto-loadable: chrome trace-event required keys on every span
+    for ev in doc["traceEvents"]:
+        if ev.get("ph") == "X":
+            for k in ("name", "ts", "dur", "pid", "tid"):
+                assert k in ev, f"span missing {k}: {ev}"
+
+    # JSONL: every line parses, and the 1 KiB cap forced a rotation
+    lines = [json.loads(l) for l in open(log_path)]
+    assert lines and all("metrics" in l and "ts" in l for l in lines)
+    assert os.path.exists(log_path + ".1"), \
+        "telemetry_log_max_kb=1 produced no rotation"
+
+    # ---- phase 2: traced serve + one /metrics scrape --------------------
+    from cxxnet_tpu import wrapper
+    from cxxnet_tpu.serve.server import ServeServer
+
+    net_cfg = NET_CFG
+    model = os.path.join(td, "models", "0002.model")
+    engine = wrapper.create_engine(net_cfg, model, buckets="2,4,8",
+                                   max_batch=8)
+    srv = ServeServer(engine, port=0, max_latency_ms=20,
+                      log_interval_s=0, silent=True).start()
+    try:
+        rng = np.random.RandomState(0)
+        for n in (1, 3, 7):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/predict",
+                data=json.dumps(
+                    {"data": rng.randn(n, 16).tolist()}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                out = json.loads(r.read())
+            assert len(out["pred"]) == n
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=30) as r:
+            body = r.read().decode("utf-8")
+            ctype = r.headers.get("Content-Type", "")
+    finally:
+        srv.stop()
+    assert "version=0.0.4" in ctype, f"bad /metrics content type {ctype}"
+    samples = parse_prometheus(body)
+    # ONE scrape must expose serve + resilience/checkpoint + steptime
+    # (+ io, when a threadbuffer ran) metrics together — the "one
+    # registry" acceptance criterion
+    eng = engine.stats.instance
+    want = [
+        'cxxnet_serve_requests_total{engine="%s",result="ok"}' % eng,
+        'cxxnet_serve_cache_events_total{engine="%s",event="miss"}' % eng,
+        "cxxnet_ckpt_io_seconds_count{op=\"save\"}",
+        "cxxnet_steptime_syncs_total",
+        "cxxnet_steptime_steps_total",
+    ]
+    for key in want:
+        assert key in samples, f"{key} missing from /metrics scrape"
+    assert samples['cxxnet_serve_requests_total{engine="%s",result="ok"}'
+                   % eng] == 3.0
+
+    # serve lifecycle spans landed in the (still-enabled) tracer ring
+    names = {e["name"] for e in TRACER.events()}
+    for name in SERVE_SPANS:
+        assert name in names, f"serve span {name!r} missing: {sorted(names)}"
+
+    print("smoke_telemetry OK:", json.dumps({
+        "steps": probe.steps, "syncs": probe.syncs,
+        "verdict": probe.verdict(),
+        "train_spans": {k: spans[k] for k in TRAIN_SPANS},
+        "jsonl_lines": len(lines),
+        "metrics_samples": len(samples)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
