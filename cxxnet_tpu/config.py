@@ -74,6 +74,30 @@ class Policy:
         return jnp.dtype(self.compute_dtype).name
 
 
+# -- fused kernel selection ---------------------------------------------------
+
+# accepted spellings of the ``fused_kernels`` config value -> canonical mode
+_FUSED_MODES = {
+    "auto": "auto", "": "auto",
+    "1": "on", "on": "on", "true": "on", "yes": "on",
+    "0": "off", "off": "off", "false": "off", "no": "off",
+}
+
+
+def parse_fused_mode(val: str) -> str:
+    """Canonicalize the ``fused_kernels`` knob (doc/tasks.md "Fused
+    kernels") to auto|on|off. ``auto`` selects the Pallas kernels on
+    TPU backends only; ``on`` forces them everywhere (interpret mode
+    off-TPU — the CPU test path); ``off`` is the escape hatch back to
+    the jnp references. The same values are honored by the
+    ``CXXNET_FUSED_KERNELS`` env override (ops/fused.py)."""
+    canon = _FUSED_MODES.get(str(val).strip().lower())
+    if canon is None:
+        raise ConfigError(
+            f"fused_kernels must be one of auto|1|0 (got {val!r})")
+    return canon
+
+
 # -- telemetry ----------------------------------------------------------------
 
 @dataclasses.dataclass(frozen=True)

@@ -286,6 +286,62 @@ def build_graph(cfg: ConfigPairs) -> NetGraph:
     return graph
 
 
+#: producers whose epilogue can absorb a following relu (fused-kernel
+#: suite, doc/tasks.md "Fused kernels"): batch_norm fuses it into the
+#: normalize pass, conv/fullc into the bias epilogue
+ACT_FUSABLE_PRODUCERS = ("batch_norm", "batch_norm_no_ma", "conv", "fullc")
+
+
+def act_fusion_plan(graph: NetGraph):
+    """Static activation-fold plan for the fused kernel suite: find
+    producer -> relu edges where the relu can be absorbed into the
+    producer's fused epilogue.
+
+    Returns ``(fuse_act, folded)``: ``fuse_act`` maps a producer layer
+    index to the activation name it must apply ("relu"), ``folded`` is
+    the set of relu layer indices that become pass-throughs in
+    ``Network.apply``. The fold is VALUE-preserving for every node a
+    later layer reads:
+
+    * an in-place relu (``layer[+0]``) rewrites the producer's node, so
+      all later consumers already read the post-activation value — safe
+      regardless of fan-out;
+    * a relu writing a new node is folded only when it is the SOLE
+      consumer of the producer's output (otherwise some layer reads the
+      pre-activation value, which the fold would destroy).
+
+    Numerics are identical whether or not a fused kernel is actually
+    selected at trace time: folded producers apply the activation in
+    their reference path too (see the layers), so the plan can be
+    computed once per Network regardless of backend.
+    """
+    consumers: Dict[int, List[int]] = {}
+    for li, spec in enumerate(graph.layers):
+        for ni in set(spec.nindex_in):
+            consumers.setdefault(ni, []).append(li)
+    fuse_act: Dict[int, str] = {}
+    folded: set = set()
+    for li, spec in enumerate(graph.layers):
+        if spec.is_shared or spec.type not in ACT_FUSABLE_PRODUCERS:
+            continue
+        if len(spec.nindex_out) != 1:
+            continue
+        out = spec.nindex_out[0]
+        later = sorted(c for c in consumers.get(out, []) if c > li)
+        if not later:
+            continue
+        ri = later[0]
+        rs = graph.layers[ri]
+        if (rs.type != "relu" or rs.is_shared or rs.nindex_in != [out]
+                or len(rs.nindex_out) != 1):
+            continue
+        if rs.nindex_out[0] != out and len(later) > 1:
+            continue     # another layer reads the pre-activation node
+        fuse_act[li] = "relu"
+        folded.add(ri)
+    return fuse_act, folded
+
+
 def global_param(cfg: ConfigPairs, name: str, default: str = "") -> str:
     """Last-wins lookup of a global setting (CLI overrides come last)."""
     out = default

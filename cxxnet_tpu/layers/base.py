@@ -146,6 +146,17 @@ class ApplyCtx:
     # across microbatches and the trainer merges one exact full-batch EMA
     # update after the ring (see Network.apply_stage)
     stat_sink: Optional[Dict[str, Any]] = None
+    # fused Pallas kernel selection (ops/fused.py): True when this trace
+    # may use the fused BN/LRN/epilogue kernels — resolved by the
+    # Network per call (knob x backend x single-device). Layers must
+    # treat it as a hint: unsupported shapes fall back to their jnp
+    # reference inside the same apply.
+    fused: bool = False
+    # activation folded into this layer's epilogue by the graph-level
+    # plan (graph.act_fusion_plan): "relu" or None. Layers honoring it
+    # MUST apply the activation on their reference path too — the fold
+    # is decided statically, kernel selection per trace.
+    fuse_act: Optional[str] = None
 
 
 class Layer:

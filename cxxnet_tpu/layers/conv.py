@@ -95,8 +95,20 @@ class ConvolutionLayer(Layer):
                 padding=((hp.pad_y, hp.pad_y), (hp.pad_x, hp.pad_x)),
                 dimension_numbers=("NHWC", "HWIO", "NHWC"),
                 feature_group_count=hp.num_group)
-        if "bias" in params:
-            y = y + params["bias"].astype(y.dtype)
+        bias = params.get("bias")
+        act = ctx.fuse_act or "none"   # graph-folded relu (act_fusion_plan)
+        if ctx.fused and (bias is not None or act != "none"):
+            # fused bias+activation epilogue (ops/fused_epilogue.py):
+            # the conv stays on XLA's MXU lowering, the epilogue runs
+            # as one Pallas pass (None -> unsupported shape, fall back)
+            from ..ops.fused_epilogue import fused_bias_act
+            fy = fused_bias_act(y, bias, act)
+            if fy is not None:
+                return [fy], state
+        if bias is not None:
+            y = y + bias.astype(y.dtype)
+        if act == "relu":
+            y = jax.nn.relu(y)
         return [y], state
 
     def _use_space_to_depth(self) -> bool:
@@ -308,6 +320,18 @@ class LRNLayer(Layer):
 
     def apply(self, params, state, inputs, ctx):
         x = inputs[0]
+        if ctx.fused:
+            # fused cross-channel window kernel (ops/fused_lrn.py — the
+            # classic cxxnet hand-fused LRN, TPU-native): square,
+            # window-sum, powf, product in ONE VMEM pass, fused backward,
+            # and no fusion barrier needed (a pallas_call is opaque to
+            # the consumer-conv refusion this layer's barrier guards
+            # against). None -> unsupported shape, jnp path below.
+            from ..ops.fused_lrn import fused_lrn
+            fy = fused_lrn(x, self.nsize, self.alpha, self.beta,
+                           self.knorm)
+            if fy is not None:
+                return [fy], state
         sq = jnp.square(x)
         half = self.nsize // 2
         # window sum over channels via pad + strided slice sum; unrolled

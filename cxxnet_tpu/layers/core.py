@@ -62,8 +62,19 @@ class FullConnectLayer(Layer):
         x = _flat2d(inputs[0])
         w = params["wmat"].astype(ctx.compute_dtype)
         y = jnp.dot(x.astype(ctx.compute_dtype), w)
-        if "bias" in params:
-            y = y + params["bias"].astype(y.dtype)
+        bias = params.get("bias")
+        act = ctx.fuse_act or "none"   # graph-folded relu (act_fusion_plan)
+        if ctx.fused and (bias is not None or act != "none"):
+            # fused bias+activation epilogue (ops/fused_epilogue.py) on
+            # the matmul output; None -> unsupported shape, jnp path
+            from ..ops.fused_epilogue import fused_bias_act
+            fy = fused_bias_act(_as_node(y), bias, act)
+            if fy is not None:
+                return [fy], state
+        if bias is not None:
+            y = y + bias.astype(y.dtype)
+        if act == "relu":
+            y = jax.nn.relu(y)
         return [_as_node(y)], state
 
     def param_pspecs(self):

@@ -53,6 +53,17 @@ class Network:
         # recipe for memory-bound models (no reference analog; the closest
         # is temp_col_max's memory/compute staging, SURVEY §5)
         self.remat = bool(int(global_param(cfg, "remat", "0")))
+        # fused Pallas kernel suite (ops/fused.py; doc/tasks.md "Fused
+        # kernels"): fused_kernels = auto|1|0 — auto selects on TPU,
+        # 1 forces (interpret off-TPU, the test path), 0 restores the
+        # jnp references. The trainer clears fused_single_device on
+        # multi-device meshes: a pallas_call is opaque to the GSPMD
+        # partitioner and fused BN moments would be shard-local where
+        # the jnp path is sync-BN.
+        from .ops.fused import resolve_mode
+        self.fused_mode = resolve_mode(
+            global_param(cfg, "fused_kernels", "auto"))
+        self.fused_single_device = True
         self._tp_plan_logged = False
         # build layer objects; shared specs reuse the primary object
         self.layers: List[Layer] = []
@@ -84,6 +95,23 @@ class Network:
         self._in_shapes_of = [
             [self.node_shapes[ni] for ni in spec.nindex_in]
             for spec in graph.layers]
+        # static activation-fold plan (graph.act_fusion_plan): producer
+        # layers absorb a following relu into their (possibly fused)
+        # epilogue; the folded relus pass through in apply(). Numerics
+        # are backend-independent — producers apply the act on their
+        # reference path too — so the plan is computed unconditionally
+        # unless the knob is a hard off.
+        if self.fused_mode != "off":
+            from .graph import act_fusion_plan
+            self._fuse_act, self._act_folded = act_fusion_plan(graph)
+        else:
+            self._fuse_act, self._act_folded = {}, set()
+
+    def _fused_now(self) -> bool:
+        """Per-trace fused-kernel decision: knob/env x backend (ops.
+        fused.kernels_active) x the trainer's single-device gate."""
+        from .ops.fused import kernels_active
+        return self.fused_single_device and kernels_active(self.fused_mode)
 
     # -- init --------------------------------------------------------------
     def init(self, key: jax.Array) -> Tuple[Params, NetState]:
@@ -142,11 +170,20 @@ class Network:
             rng = jax.random.PRNGKey(0)
         new_state: NetState = dict(state)
         cdt = self.compute_dtype if compute_dtype is None else compute_dtype
+        fused_now = self._fused_now()
         total_loss = jnp.zeros((), jnp.float32)
         for li, (spec, layer) in enumerate(zip(g.layers, self.layers)):
+            if li in self._act_folded:
+                # relu folded into its producer's epilogue
+                # (graph.act_fusion_plan): the producer already applied
+                # it, so this layer is a pass-through
+                nodes[spec.nindex_out[0]] = nodes[spec.nindex_in[0]]
+                continue
             ctx = ApplyCtx(train=train, rng=jax.random.fold_in(rng, li),
                            compute_dtype=cdt,
-                           seq_axis=seq_axis, data_axis=data_axis)
+                           seq_axis=seq_axis, data_axis=data_axis,
+                           fused=fused_now,
+                           fuse_act=self._fuse_act.get(li))
             inputs = [nodes[ni] for ni in spec.nindex_in]
             lparams = params.get(layer.name, {})
             lstate = new_state.get(layer.name, {})
@@ -155,7 +192,9 @@ class Network:
                     c = ApplyCtx(train=_ctx.train, rng=rng_,
                                  compute_dtype=_ctx.compute_dtype,
                                  seq_axis=_ctx.seq_axis,
-                                 data_axis=_ctx.data_axis)
+                                 data_axis=_ctx.data_axis,
+                                 fused=_ctx.fused,
+                                 fuse_act=_ctx.fuse_act)
                     return _layer.apply(lp, ls, list(ins), c)
                 outputs, lstate_out = jax.checkpoint(_fn)(
                     lparams, lstate, ctx.rng, *inputs)

@@ -13,10 +13,25 @@ from .attention import (
     flash_attention,
     rope,
 )
+from .fused import kernels_active, resolve_mode
+from .fused_epilogue import bias_act_reference, fused_bias_act
+from .fused_lrn import fused_lrn, lrn_reference
+from .fused_norm import bn_act_reference, fused_bn_act
+from .fused_optim import fused_adam_apply, fused_sgd_apply
 
 __all__ = [
     "attention_reference",
     "chunked_attention",
     "flash_attention",
     "rope",
+    "kernels_active",
+    "resolve_mode",
+    "fused_bn_act",
+    "bn_act_reference",
+    "fused_lrn",
+    "lrn_reference",
+    "fused_bias_act",
+    "bias_act_reference",
+    "fused_sgd_apply",
+    "fused_adam_apply",
 ]

@@ -1,0 +1,102 @@
+"""Shared plumbing for the fused Pallas kernel suite (doc/tasks.md
+"Fused kernels").
+
+Selection contract — the one rule every fused op follows:
+
+* ``fused_kernels = auto`` (default): kernels are selected on TPU
+  backends only; every other backend runs the jnp reference the layer
+  already shipped. This is the production setting — the flagship bench
+  is HBM-bound (BENCH_r02–r04: ~100–105% of the bandwidth roofline at
+  MFU ~28%), and the fused kernels exist to move fewer HBM bytes per
+  step, which only a real TPU pays for.
+* ``fused_kernels = 1``: kernels are selected everywhere; off-TPU they
+  run under ``interpret=True`` (the flash-attention testing pattern —
+  the SAME kernel code is exercised by CPU tests and smokes).
+* ``fused_kernels = 0``: jnp references everywhere — the escape hatch.
+* env ``CXXNET_FUSED_KERNELS`` overrides the config knob with the same
+  values (ops-level kill switch that needs no config edit).
+
+Gating beyond the knob (callers, not this module): fused ops are
+single-device only — a ``pallas_call`` is an opaque custom call the
+GSPMD partitioner cannot shard, and the fused BN's moments would be
+shard-local where the jnp path's ``jnp.mean`` is a sync-BN collective.
+The trainer clears ``Network.fused_single_device`` /
+``Optimizer.fused_ok`` on multi-device meshes.
+
+Every fused op returns ``None`` for unsupported shapes/dtypes and the
+caller falls back to its reference implementation, so selection is
+always safe — never an error.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from ..config import parse_fused_mode
+
+try:  # same lazy-import guard as ops/attention.py: CPU-only installs
+    from jax.experimental import pallas as pl           # noqa: F401
+    from jax.experimental.pallas import tpu as pltpu    # noqa: F401
+    HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    HAVE_PALLAS = False
+
+#: dtypes the fused kernels accept as activation inputs; everything is
+#: accumulated in f32 inside the kernels regardless.
+SUPPORTED_DTYPES = ("float32", "bfloat16", "float16")
+
+#: canonicalize a ``fused_kernels`` value -> auto|on|off (the config
+#: layer owns the grammar; re-exported here for the ops-side callers)
+resolve_mode = parse_fused_mode
+
+
+def kernels_active(mode: str) -> bool:
+    """Trace-time selection decision for a resolved mode string. The
+    ``CXXNET_FUSED_KERNELS`` env var wins over the config knob."""
+    env = os.environ.get("CXXNET_FUSED_KERNELS", "")
+    if env:
+        mode = resolve_mode(env)
+    if mode == "off" or not HAVE_PALLAS:
+        return False
+    if mode == "on":
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def use_interpret(interpret: Optional[bool]) -> bool:
+    """interpret=None auto-selects interpreter mode off-TPU — the same
+    kernel is exercised in CPU tests (flash_attention's contract)."""
+    if interpret is None:
+        return jax.default_backend() != "tpu"
+    return interpret
+
+
+def row_block(n: int, target: int = 256, mult: int = 8) -> Optional[int]:
+    """Largest row-block size that (a) divides ``n`` exactly, (b) is a
+    multiple of ``mult`` (the sublane tile: 8 for f32, 16 for
+    bf16/f16 — see sublane_mult), and (c) is <= ``target`` (VMEM
+    residency cap). ``None`` when ``n`` has no such divisor — the
+    caller falls back to its jnp reference (no remainder masking:
+    unsupported is cheaper than wrong)."""
+    if n <= 0 or n % mult:
+        return None
+    best = None
+    for b in range(mult, min(target, n) + 1, mult):
+        if n % b == 0:
+            best = b
+    return best
+
+
+def sublane_mult(x: jax.Array) -> int:
+    """Min sublane tile multiple for this dtype's TPU layout: (8, 128)
+    for f32, (16, 128) for the 16-bit floats."""
+    import jax.numpy as jnp
+    return 8 if jnp.dtype(x.dtype).itemsize == 4 else 16
+
+
+def supported_dtype(x: jax.Array) -> bool:
+    import jax.numpy as jnp
+    return jnp.dtype(x.dtype).name in SUPPORTED_DTYPES

@@ -1,0 +1,183 @@
+"""Fused bias + activation epilogue for conv/fullc outputs.
+
+The cxxnet reference hand-fused bias-add and activation into its conv
+kernels' epilogues; here the conv/matmul itself stays on XLA's MXU
+lowering (it wins there) and only the epilogue — bias broadcast-add
+plus the (graph-folded, see graph.act_fusion_plan) relu — runs as one
+Pallas kernel: one streaming read of the conv output, one write, with
+the backward fusing the dx mask and the per-channel dbias reduction
+into a single pass (the autodiff graph otherwise schedules the relu
+mask, the dbias reduce, and the dx select as separate HBM-visible
+values in cost_analysis' accounting).
+
+Views everything as (N, C) rows like the other fused ops. ``act`` may
+be "relu" or "none"; ``bias`` may be None (act-only epilogue — the
+no_bias conv -> relu case). Returns ``None`` when unsupported or when
+there is nothing to fuse (no bias AND no act).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .fused import (HAVE_PALLAS, row_block, sublane_mult,
+                    supported_dtype, use_interpret)
+
+if HAVE_PALLAS:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+
+def bias_act_reference(x: jax.Array, bias: Optional[jax.Array],
+                       act: str = "none") -> jax.Array:
+    """Golden jnp implementation, matching the layers' existing math
+    (bias cast to the activation dtype before the add)."""
+    y = x if bias is None else x + bias.astype(x.dtype)
+    if act == "relu":
+        y = jax.nn.relu(y)
+    return y
+
+
+def _epi_fwd_kernel(*refs, act, has_bias):
+    if has_bias:
+        x_ref, b_ref, y_ref = refs
+        y = x_ref[...] + b_ref[...].astype(x_ref.dtype)
+    else:
+        x_ref, y_ref = refs
+        y = x_ref[...]
+    if act == "relu":
+        y = jnp.maximum(y, 0)
+    y_ref[...] = y
+
+
+def _epi_bwd_kernel(*refs, act, has_bias, nb):
+    """dx per block; dbias accumulates across the (sequential) grid in
+    scratch and lands in its (1, C) output at the last step."""
+    if has_bias:
+        y_ref, dy_ref, dx_ref, db_ref, acc = refs
+    else:
+        y_ref, dy_ref, dx_ref = refs
+        db_ref = acc = None
+    j = pl.program_id(0)
+    dyb = dy_ref[...]
+    if act == "relu":
+        dyb = jnp.where(y_ref[...] > 0, dyb, 0)
+    dx_ref[...] = dyb
+    if has_bias:
+        @pl.when(j == 0)
+        def _init():
+            acc[...] = jnp.zeros_like(acc)
+        acc[...] += jnp.sum(dyb.astype(jnp.float32), axis=0, keepdims=True)
+
+        @pl.when(j == nb - 1)
+        def _finish():
+            db_ref[...] = acc[...]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _epi_act_2d(x2, act, interpret, bn):
+    """act-only epilogue (no bias)."""
+    n, c = x2.shape
+    return pl.pallas_call(
+        functools.partial(_epi_fwd_kernel, act=act, has_bias=False),
+        grid=(n // bn,),
+        in_specs=[pl.BlockSpec((bn, c), lambda j: (j, 0))],
+        out_specs=pl.BlockSpec((bn, c), lambda j: (j, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, c), x2.dtype),
+        interpret=interpret,
+    )(x2)
+
+
+def _epi_act_fwd(x2, act, interpret, bn):
+    y = _epi_act_2d(x2, act, interpret, bn)
+    return y, y
+
+
+def _epi_act_bwd(act, interpret, bn, y, dy):
+    n, c = y.shape
+    dx = pl.pallas_call(
+        functools.partial(_epi_bwd_kernel, act=act, has_bias=False,
+                          nb=n // bn),
+        grid=(n // bn,),
+        in_specs=[pl.BlockSpec((bn, c), lambda j: (j, 0)),
+                  pl.BlockSpec((bn, c), lambda j: (j, 0))],
+        out_specs=pl.BlockSpec((bn, c), lambda j: (j, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, c), y.dtype),
+        interpret=interpret,
+    )(y, dy)
+    return (dx,)
+
+
+_epi_act_2d.defvjp(_epi_act_fwd, _epi_act_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _epi_bias_2d(x2, bias, act, interpret, bn):
+    n, c = x2.shape
+    return pl.pallas_call(
+        functools.partial(_epi_fwd_kernel, act=act, has_bias=True),
+        grid=(n // bn,),
+        in_specs=[pl.BlockSpec((bn, c), lambda j: (j, 0)),
+                  pl.BlockSpec((1, c), lambda j: (0, 0))],
+        out_specs=pl.BlockSpec((bn, c), lambda j: (j, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, c), x2.dtype),
+        interpret=interpret,
+    )(x2, bias.reshape(1, c))
+
+
+def _epi_bias_fwd(x2, bias, act, interpret, bn):
+    y = _epi_bias_2d(x2, bias, act, interpret, bn)
+    return y, (y, bias)
+
+
+def _epi_bias_bwd(act, interpret, bn, res, dy):
+    y, bias = res
+    n, c = y.shape
+    dx, db = pl.pallas_call(
+        functools.partial(_epi_bwd_kernel, act=act, has_bias=True,
+                          nb=n // bn),
+        grid=(n // bn,),
+        in_specs=[pl.BlockSpec((bn, c), lambda j: (j, 0)),
+                  pl.BlockSpec((bn, c), lambda j: (j, 0))],
+        out_specs=[pl.BlockSpec((bn, c), lambda j: (j, 0)),
+                   pl.BlockSpec((1, c), lambda j: (0, 0))],
+        out_shape=[jax.ShapeDtypeStruct((n, c), y.dtype),
+                   jax.ShapeDtypeStruct((1, c), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((1, c), jnp.float32)],
+        interpret=interpret,
+    )(y, dy)
+    return dx, db.reshape(bias.shape).astype(bias.dtype)
+
+
+_epi_bias_2d.defvjp(_epi_bias_fwd, _epi_bias_bwd)
+
+
+def fused_bias_act(x: jax.Array, bias: Optional[jax.Array],
+                   act: str = "none", interpret: Optional[bool] = None,
+                   block_rows: int = 512):
+    """Fused epilogue on an NHWC/flat node's trailing channel axis.
+    Returns y (x.dtype) or ``None`` when unsupported / nothing to
+    fuse."""
+    if not HAVE_PALLAS or not supported_dtype(x):
+        return None
+    if x.ndim != 4 or act not in ("none", "relu"):
+        return None
+    if bias is None and act == "none":
+        return None                      # nothing to fuse
+    c = x.shape[-1]
+    n = x.size // c
+    target = max(8, min(block_rows, (1 << 20) // max(4 * c, 1) // 8 * 8))
+    bn = row_block(n, target, mult=sublane_mult(x))
+    if bn is None or (bias is not None and bias.shape != (c,)):
+        return None
+    x2 = x.reshape(n, c)
+    itp = use_interpret(interpret)
+    if bias is None:
+        y = _epi_act_2d(x2, act, itp, bn)
+    else:
+        y = _epi_bias_2d(x2, bias, act, itp, bn)
+    return y.reshape(x.shape)

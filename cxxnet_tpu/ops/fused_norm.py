@@ -1,0 +1,294 @@
+"""Fused BatchNorm + activation: Pallas TPU kernels + jnp reference.
+
+The flagship Inception-BN step is memory-bound (BENCH_r02–r04:
+roofline_pct ~100–105% at arith_intensity ~64), and its dominant
+non-conv HBM traffic is the conv -> batch_norm -> relu chain: the jnp
+path reads the conv output for the moments, again for the normalize,
+and writes the normalized activation, with the relu riding a fourth
+logical pass XLA must fuse back in. The fused kernel does moments,
+normalize, scale/shift, and the activation in ONE ``pallas_call``
+whose HBM traffic is exactly two streaming reads of x plus one write
+of y — the minimum any batch-norm can do (the mean must exist before
+the first output byte) — and the backward rebuilds x_hat from saved
+(mean, rstd) residuals in one fused pass of its own (two reads of
+x/dy + one write of dx) instead of the 5+ reduction/elementwise
+kernels the autodiff graph schedules.
+
+Layout: activations are viewed as (N, C) rows — N = batch*H*W for
+conv nodes, N = batch for flat nodes — with per-channel statistics
+reduced over rows. The row dimension is tiled (``fused.row_block``);
+the channel dimension stays whole in VMEM (C is at most a few
+thousand for every shipped config).
+
+Variance options (the ADVICE r5 fold-in):
+
+* ``two_pass=False`` (default, reference parity): one-pass
+  E[x^2]-E[x]^2 with a clamp at 0 — grid of 2 row-sweeps.
+* ``two_pass=True``: numerically-robust E[(x-mean)^2] — grid of 3
+  row-sweeps (one extra streaming read of x, no cancellation risk).
+
+``fused_bn_act`` returns ``(y, mean, var)`` or ``None`` when the
+shape/dtype is unsupported (caller falls back to its jnp reference).
+``mean``/``var`` feed the layer's running-stat EMA only and are
+treated as non-differentiable by the custom_vjp (their cotangents are
+structurally zero: no loss reads them).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .fused import (HAVE_PALLAS, row_block, sublane_mult,
+                    supported_dtype, use_interpret)
+
+if HAVE_PALLAS:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+
+def bn_act_reference(x: jax.Array, gamma: jax.Array, beta: jax.Array,
+                     eps: float, act: str = "none",
+                     two_pass: bool = False):
+    """Golden jnp implementation on NHWC/flat nodes: returns
+    ``(y, mean, var)`` with f32 per-channel stats over all leading
+    axes, matching layers/norm.py's training math exactly."""
+    axes = tuple(range(x.ndim - 1))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes)
+    if two_pass:
+        var = jnp.mean(jnp.square(xf - mean), axis=axes)
+    else:
+        var = jnp.maximum(
+            jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean), 0.0)
+    inv = jax.lax.rsqrt(var + eps)
+    out = (x - mean) * inv * gamma + beta
+    if act == "relu":
+        out = jax.nn.relu(out)
+    return out.astype(x.dtype), mean, var
+
+
+# -- forward kernel -----------------------------------------------------------
+
+def _bn_fwd_kernel(x_ref, gamma_ref, beta_ref, y_ref, mean_ref, var_ref,
+                   acc1, acc2, *, nb, n_total, eps, act, two_pass):
+    """Row-sweep phases over grid (2*nb,) or (3*nb,) — the x BlockSpec
+    maps every phase back onto the same nb row blocks, so x streams
+    through VMEM once per sweep while the (1, C) accumulators persist
+    in scratch across the whole grid (flash-attention pattern)."""
+    j = pl.program_id(0)
+
+    @pl.when(j == 0)
+    def _init():
+        acc1[...] = jnp.zeros_like(acc1)
+        acc2[...] = jnp.zeros_like(acc2)
+
+    if two_pass:
+        @pl.when(j < nb)
+        def _sum():
+            xb = x_ref[...].astype(jnp.float32)
+            acc1[...] += jnp.sum(xb, axis=0, keepdims=True)
+
+        @pl.when(j == nb - 1)
+        def _mean():
+            acc1[...] = acc1[...] / n_total        # acc1 becomes mean
+
+        @pl.when(jnp.logical_and(j >= nb, j < 2 * nb))
+        def _sumsq():
+            d = x_ref[...].astype(jnp.float32) - acc1[...]
+            acc2[...] += jnp.sum(d * d, axis=0, keepdims=True)
+
+        @pl.when(j == 2 * nb - 1)
+        def _finish_stats():
+            var = acc2[...] / n_total
+            mean_ref[...] = acc1[...]
+            var_ref[...] = var
+            acc2[...] = jax.lax.rsqrt(var + eps)   # acc2 becomes rstd
+        norm_from = 2 * nb
+    else:
+        @pl.when(j < nb)
+        def _sums():
+            xb = x_ref[...].astype(jnp.float32)
+            acc1[...] += jnp.sum(xb, axis=0, keepdims=True)
+            acc2[...] += jnp.sum(xb * xb, axis=0, keepdims=True)
+
+        @pl.when(j == nb - 1)
+        def _finish_stats2():
+            mean = acc1[...] / n_total
+            # one-pass E[x^2]-E[x]^2, clamped at 0 (f32 cancellation
+            # can push it a hair negative) — layers/norm.py parity
+            var = jnp.maximum(acc2[...] / n_total - mean * mean, 0.0)
+            mean_ref[...] = mean
+            var_ref[...] = var
+            acc1[...] = mean
+            acc2[...] = jax.lax.rsqrt(var + eps)   # acc2 becomes rstd
+        norm_from = nb
+
+    @pl.when(j >= norm_from)
+    def _normalize():
+        xb = x_ref[...].astype(jnp.float32)
+        out = ((xb - acc1[...]) * acc2[...]
+               * gamma_ref[...].astype(jnp.float32)
+               + beta_ref[...].astype(jnp.float32))
+        if act == "relu":
+            out = jnp.maximum(out, 0.0)
+        y_ref[...] = out.astype(y_ref.dtype)
+
+
+def _bn_forward(x2, gamma, beta, eps, act, two_pass, interpret, bn):
+    n, c = x2.shape
+    nb = n // bn
+    sweeps = 3 if two_pass else 2
+    kern = functools.partial(
+        _bn_fwd_kernel, nb=nb, n_total=float(n), eps=eps, act=act,
+        two_pass=two_pass)
+    row_spec = pl.BlockSpec((bn, c), lambda j: (j % nb, 0))
+    vec_spec = pl.BlockSpec((1, c), lambda j: (0, 0))
+    y, mean, var = pl.pallas_call(
+        kern,
+        grid=(sweeps * nb,),
+        in_specs=[row_spec, vec_spec, vec_spec],
+        out_specs=[row_spec, vec_spec, vec_spec],
+        out_shape=[jax.ShapeDtypeStruct((n, c), x2.dtype),
+                   jax.ShapeDtypeStruct((1, c), jnp.float32),
+                   jax.ShapeDtypeStruct((1, c), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((1, c), jnp.float32),
+                        pltpu.VMEM((1, c), jnp.float32)],
+        interpret=interpret,
+    )(x2, gamma.reshape(1, c), beta.reshape(1, c))
+    return y, mean, var
+
+
+# -- backward kernel ----------------------------------------------------------
+
+def _bn_bwd_kernel(*refs, nb, n_total, act):
+    """Two row sweeps: (1) reduce sum(dy') and sum(dy'*x_hat) per
+    channel (dy' = dy masked by the activation), (2) the fused dx
+    formula. dgamma/dbeta fall out of the phase-1 reductions."""
+    if act == "relu":
+        (x_ref, dy_ref, y_ref, gamma_ref, mean_ref, rstd_ref,
+         dx_ref, dgamma_ref, dbeta_ref, sb, sxh) = refs
+    else:
+        (x_ref, dy_ref, gamma_ref, mean_ref, rstd_ref,
+         dx_ref, dgamma_ref, dbeta_ref, sb, sxh) = refs
+        y_ref = None
+    j = pl.program_id(0)
+
+    @pl.when(j == 0)
+    def _init():
+        sb[...] = jnp.zeros_like(sb)
+        sxh[...] = jnp.zeros_like(sxh)
+
+    def _dyp_xhat():
+        dyb = dy_ref[...].astype(jnp.float32)
+        if y_ref is not None:
+            dyb = jnp.where(y_ref[...].astype(jnp.float32) > 0.0, dyb, 0.0)
+        xh = ((x_ref[...].astype(jnp.float32) - mean_ref[...])
+              * rstd_ref[...])
+        return dyb, xh
+
+    @pl.when(j < nb)
+    def _reduce():
+        dyb, xh = _dyp_xhat()
+        sb[...] += jnp.sum(dyb, axis=0, keepdims=True)
+        sxh[...] += jnp.sum(dyb * xh, axis=0, keepdims=True)
+
+    @pl.when(j == nb - 1)
+    def _grads():
+        dgamma_ref[...] = sxh[...]
+        dbeta_ref[...] = sb[...]
+
+    @pl.when(j >= nb)
+    def _dx():
+        dyb, xh = _dyp_xhat()
+        g = gamma_ref[...].astype(jnp.float32) * rstd_ref[...]
+        dx = g * (dyb - sb[...] / n_total - xh * (sxh[...] / n_total))
+        dx_ref[...] = dx.astype(dx_ref.dtype)
+
+
+def _bn_backward(x2, gamma, mean, rstd, y2, dy2, act, interpret, bn):
+    n, c = x2.shape
+    nb = n // bn
+    kern = functools.partial(_bn_bwd_kernel, nb=nb, n_total=float(n),
+                             act=act)
+    row_spec = pl.BlockSpec((bn, c), lambda j: (j % nb, 0))
+    vec_spec = pl.BlockSpec((1, c), lambda j: (0, 0))
+    ins = [x2, dy2] + ([y2] if act == "relu" else [])
+    ins += [gamma.reshape(1, c), mean, rstd]
+    in_specs = [row_spec, row_spec] + \
+        ([row_spec] if act == "relu" else []) + [vec_spec] * 3
+    dx, dgamma, dbeta = pl.pallas_call(
+        kern,
+        grid=(2 * nb,),
+        in_specs=in_specs,
+        out_specs=[row_spec, vec_spec, vec_spec],
+        out_shape=[jax.ShapeDtypeStruct((n, c), x2.dtype),
+                   jax.ShapeDtypeStruct((1, c), jnp.float32),
+                   jax.ShapeDtypeStruct((1, c), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((1, c), jnp.float32),
+                        pltpu.VMEM((1, c), jnp.float32)],
+        interpret=interpret,
+    )(*ins)
+    return dx, dgamma, dbeta
+
+
+# -- custom_vjp wrapper -------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _bn_act_2d(x2, gamma, beta, eps, act, two_pass, interpret, bn):
+    y, mean, var = _bn_forward(x2, gamma, beta, eps, act, two_pass,
+                               interpret, bn)
+    return y, mean, var
+
+
+def _bn_act_fwd(x2, gamma, beta, eps, act, two_pass, interpret, bn):
+    y, mean, var = _bn_forward(x2, gamma, beta, eps, act, two_pass,
+                               interpret, bn)
+    rstd = jax.lax.rsqrt(var + eps)
+    res = (x2, gamma, mean, rstd, y if act == "relu" else None)
+    return (y, mean, var), res
+
+
+def _bn_act_bwd(eps, act, two_pass, interpret, bn, res, cts):
+    # cts = (dy, dmean, dvar); mean/var feed the running-stat EMA only
+    # (carried state, never read by the loss), so their cotangents are
+    # structurally zero and are dropped here — same contract as
+    # flash_attention's lse output.
+    x2, gamma, mean, rstd, y2 = res
+    dy = cts[0]
+    dx, dgamma, dbeta = _bn_backward(x2, gamma, mean, rstd, y2, dy, act,
+                                     interpret, bn)
+    return (dx, dgamma.reshape(gamma.shape).astype(gamma.dtype),
+            dbeta.reshape(gamma.shape).astype(gamma.dtype))
+
+
+_bn_act_2d.defvjp(_bn_act_fwd, _bn_act_bwd)
+
+
+def fused_bn_act(x: jax.Array, gamma: jax.Array, beta: jax.Array,
+                 eps: float, act: str = "none", two_pass: bool = False,
+                 interpret: Optional[bool] = None,
+                 block_rows: int = 256):
+    """Fused train-time batch norm (+ optional relu) over the trailing
+    channel axis of an NHWC or flat node. Returns ``(y, mean, var)``
+    with y in x.dtype and f32 stats, or ``None`` when unsupported
+    (caller falls back to the jnp reference)."""
+    if not HAVE_PALLAS or not supported_dtype(x):
+        return None
+    if x.ndim != 4 or act not in ("none", "relu"):
+        return None
+    c = x.shape[-1]
+    n = x.size // c
+    # keep ~2 row blocks + accumulators comfortably inside VMEM even
+    # for wide flat nodes: shrink the row tile as C grows
+    target = max(8, min(block_rows, (1 << 20) // max(4 * c, 1) // 8 * 8))
+    bn = row_block(n, target, mult=sublane_mult(x))
+    if bn is None or gamma.shape != (c,) or beta.shape != (c,):
+        return None
+    x2 = x.reshape(n, c)
+    y, mean, var = _bn_act_2d(x2, gamma, beta, float(eps), act,
+                              bool(two_pass), use_interpret(interpret), bn)
+    return y.reshape(x.shape), mean.reshape(c), var.reshape(c)

@@ -164,6 +164,15 @@ class Trainer:
         self._pp_microbatch = int(gp("pipeline_microbatch",
                                      str(max(self._pp, 1))))
         self.optimizer = create_optimizer(self.graph.updater_type, cfg)
+        # fused Pallas kernels are single-device only: a pallas_call is
+        # an opaque custom call the GSPMD partitioner cannot shard, and
+        # the fused BN's moments would be shard-local where the jnp
+        # path's jnp.mean is a cross-replica sync-BN collective. The
+        # manual shard_map paths (sp/pp) never set ctx.fused, but the
+        # std GSPMD step does — gate it here.
+        if (self.mesh.num_devices > 1 or self._sp > 1 or self._pp > 1):
+            self.net.fused_single_device = False
+            self.optimizer.fused_ok = False
         # metric bindings (reference nnet_impl-inl.hpp:73-83)
         self.metric = MetricSet()
         self.train_metric = MetricSet()
