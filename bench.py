@@ -147,6 +147,140 @@ def analytic_step_flops(tr, batch) -> float:
     return 3.0 * total
 
 
+def analytic_step_bytes(tr, batch) -> dict:
+    """doc/bytes_audit.md-style analytic HBM byte model of ONE train
+    step — the calibration fallback for backends whose profiler trace
+    records no memory counters. Model: every layer's forward reads its
+    inputs and writes its outputs once; the backward re-reads the saved
+    activation and the cotangent and writes dx (~2x forward), so
+    activation traffic ~= 3 * (in + out) per layer in the compute
+    dtype; params pay ~5 fp32 passes (read p/m, write p/m, grad). A
+    fusion-blind upper-estimate by construction — same epistemic status
+    as cost_analysis' pre-fusion bytes, derived independently."""
+    import jax
+    import numpy as np
+    g, net = tr.graph, tr.net
+    esize = np.dtype(net.compute_dtype).itemsize
+    act = 0.0
+    for li, spec in enumerate(g.layers):
+        ins = sum(float(np.prod(s)) for s in net._in_shapes_of[li])
+        outs = sum(float(np.prod(s)) for s in net.layer_out_shapes[li])
+        act += 3.0 * batch * (ins + outs) * esize
+    n_params = sum(leaf.size
+                   for leaf in jax.tree_util.tree_leaves(tr.params))
+    params = 5.0 * 4 * n_params
+    return {"activation_bytes": act, "param_bytes": params,
+            "total": act + params}
+
+
+def calibration_entry(cost_bytes: float, measured_bytes,
+                      analytic_bytes: float) -> dict:
+    """The calibrated-roofline record: measured (trace) HBM bytes per
+    step vs the cost_analysis estimate every BENCH round has carried.
+    ``measured_vs_cost_ratio`` is THE calibration number — <1 means XLA
+    fused below its own pre-fusion estimate (roofline_pct > 100
+    readings were real); None means the trace had no memory counters
+    and the analytic model is the only cross-check."""
+    measured = measured_bytes if measured_bytes else None
+    return {
+        "cost_analysis_bytes_per_step": round(cost_bytes, 1),
+        "measured_bytes_per_step": (round(measured, 1)
+                                    if measured else None),
+        "analytic_bytes_per_step": round(analytic_bytes, 1),
+        "measured_vs_cost_ratio": (round(measured / cost_bytes, 4)
+                                   if measured and cost_bytes else None),
+        "analytic_vs_cost_ratio": (round(analytic_bytes / cost_bytes, 4)
+                                   if cost_bytes else None),
+        "hbm_bytes_per_step_calibrated": round(measured or cost_bytes, 1),
+        "source": ("trace" if measured else
+                   "cost_analysis (trace lacked memory counters; "
+                   "analytic model is the only independent check)"),
+    }
+
+
+def profile_attribution(tr, classes, batch, k=8):
+    """Capture a jax.profiler trace of ``k`` chained flagship steps and
+    attribute device op time (and measured HBM bytes, when the backend
+    records them) per phase — telemetry.traceparse. The chain is warmed
+    (compile retired) BEFORE the bracket so the trace holds steady-state
+    steps only. Returns the attribution dict (JSON-rounded) or an
+    {"error": ...} marker — attribution is evidence, never a gate."""
+    import numpy as np
+    from cxxnet_tpu.io.data import DataBatch
+    from cxxnet_tpu.telemetry.traceparse import (attribute_profile,
+                                                 device_trace)
+    try:
+        c_in, y_in, x_in = tr.graph.input_shape
+        rng = np.random.RandomState(1)
+        b = DataBatch(
+            data=rng.rand(batch, y_in, x_in, c_in).astype(np.float32),
+            label=rng.randint(0, classes,
+                              size=(batch, 1)).astype(np.float32))
+        b.data = tr.mesh.shard_batch(b.data)
+        b.label = tr.mesh.shard_batch(b.label)
+        float(tr.update_chain(b, k)[-1])      # compile + warm, untraced
+        dump = tempfile.mkdtemp(prefix="bench_profile_")
+        # device_trace: python tracer OFF — a python-traced flagship
+        # step floods the profiler's event cap and evicts the op events
+        # the attribution exists to read
+        with device_trace(dump):
+            losses = tr.update_chain(b, k)
+            float(losses[-1])                 # value sync inside bracket
+        att = attribute_profile(dump, steps=k)
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+    att["phases"] = {
+        ph: {"ms": round(d["ms"], 4), "pct": round(d["pct"], 2),
+             "count": d["count"]}
+        for ph, d in sorted(att["phases"].items(),
+                            key=lambda kv: -kv[1]["ms"])}
+    att["total_op_ms"] = round(att["total_op_ms"], 4)
+    att["top_other"] = [(n, round(ms, 4)) for n, ms in att["top_other"]]
+    if att.get("measured_bytes_per_step"):
+        att["measured_bytes_per_step"] = round(
+            att["measured_bytes_per_step"], 1)
+    if att.get("measured_flops_per_step"):
+        att["measured_flops_per_step"] = round(
+            att["measured_flops_per_step"], 1)
+    att["dump_dir"] = dump
+    return att
+
+
+def input_fold_entry(tr, c, image, classes, batch) -> dict:
+    """Price the input_fold second-wave optimization in the same
+    artifact: cost-analysis bytes of the FOLDED step (uint8 batch +
+    in-step normalize) vs the f32-input step the headline number times,
+    PLUS the eager normalize dispatch the fold deletes (u8 read + f32
+    write + the step's f32 re-read = 9 bytes/px vs the fold's 1+2).
+    Bytes evidence, not a timing claim — the measured carrier is
+    e2e_u8, whose production path folds for real."""
+    import numpy as np
+    from cxxnet_tpu.io.data import DataBatch
+    try:
+        rng = np.random.RandomState(2)
+        u8 = rng.randint(0, 256, (batch, image, image, 3), np.uint8)
+        lab = rng.randint(0, classes, size=(batch, 1)).astype(np.float32)
+        b = DataBatch(data=u8, label=lab,
+                      norm={"mean": np.asarray([123.0, 117.0, 104.0],
+                                               np.float32),
+                            "divideby": 255.0, "scale": 1.0})
+        folded = tr._fold_capable(b)
+        cost = tr.step_cost_analysis(b)
+        in_bytes = float(u8.size)
+        eager_extra = in_bytes * (1 + 4)   # u8 read + f32 write, eager
+        f32_step = c["hbm_bytes_per_step"]
+        return {
+            "active": bool(folded),
+            "step_bytes_folded": round(cost["bytes_accessed"], 1),
+            "step_bytes_f32_input": round(f32_step, 1),
+            "eager_normalize_extra_bytes": round(eager_extra, 1),
+            "bytes_saved_per_step": round(
+                f32_step + eager_extra - cost["bytes_accessed"], 1),
+        }
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def make_conf_trainer(conf_rel, batch, platform, overrides=()):
     """Trainer from a shipped example conf's net/global sections (data
     sections dropped — the bench feeds device-resident batches)."""
@@ -541,6 +675,11 @@ def e2e_bench(tr, image, classes, batch, steps, device_normalize=0,
                      else "per-batch update (prefetch double-buffered)"),
         "timing": timing,
         "compute_dtype": dtype_name(tr),
+        # uint8 windows (device_normalize=1) ride the input_fold when
+        # the trainer has it on: normalize happens in-step, no fp32
+        # round-trip of the batch (doc/tasks.md "Input fold")
+        "input_fold": bool(getattr(tr, "input_fold", False)
+                           and device_normalize),
     }
     if chain:
         detail["tail"] = ("partial chains dropped outside the timed "
@@ -735,6 +874,13 @@ def main() -> None:
              "Default 540 (not 600): the harness's own timeout is the "
              "600 s tier, and the r05 rc=124 showed the emit must beat "
              "it with real margin, not tie it")
+    ap.add_argument(
+        "--full", action="store_true",
+        help="run the float-e2e / h2d / decode-pool sub-benches too. "
+             "The default run time-boxes to the phases that feed the "
+             "metric of record: flagship compute, fused A/B, profile "
+             "attribution, fp32 compare, ONE uint8 e2e window, and the "
+             "secondary models (ROADMAP 5b: r05 died to phase sprawl)")
     args = ap.parse_args()
     # timed paths don't pay for diagnostics: keep the BN variance-clamp
     # telemetry (min + cond + host callback per BN layer per step) out
@@ -806,6 +952,81 @@ def main() -> None:
         "n_chips": c["n_chips"],
         "chip": jax.devices()[0].device_kind,
     })
+    # -- fused-kernel A/B: the PR-5 suite's win measured ON-CHIP in the
+    # same artifact (ROADMAP item 1). The headline trainer runs
+    # fused_kernels=auto (active on TPU); one rerun with the reference
+    # path prices the suite directly. CPU runs skip: interpret-mode
+    # kernels time the interpreter, not the optimization.
+    if not on_accel:
+        fused_ab = {"skipped": "cpu backend (interpret-mode kernels "
+                               "are not a perf comparison)"}
+    elif budget.low(150, "fused_ab"):
+        fused_ab = {"skipped": "budget"}
+    else:
+        try:
+            tr_ref = make_trainer(scale, image, classes, batch, platform,
+                                  overrides=(("fused_kernels", "0"),))
+            c_ref = compute_bench(tr_ref, image, classes, batch,
+                                  max(3, steps // 2))
+            pick = ("ips", "per_step_ms", "hbm_bytes_per_step",
+                    "arith_intensity", "mfu_est", "roofline_pct",
+                    "fused_kernels")
+            fused_ab = {
+                "fused": {k: round(c[k], 3) if isinstance(c[k], float)
+                          else c[k] for k in pick},
+                "reference": {k: round(c_ref[k], 3)
+                              if isinstance(c_ref[k], float)
+                              else c_ref[k] for k in pick},
+                # >1: the fused suite's step is faster on this chip
+                "speedup_fused_vs_ref": round(
+                    c_ref["per_step_ms"] / c["per_step_ms"], 4)
+                if c["per_step_ms"] else None,
+                "bytes_ratio_fused_vs_ref": round(
+                    c["hbm_bytes_per_step"] / c_ref["hbm_bytes_per_step"],
+                    4) if c_ref["hbm_bytes_per_step"] else None,
+            }
+            del tr_ref, c_ref
+        except Exception as e:     # A/B is evidence, not a gate
+            fused_ab = {"error": f"{type(e).__name__}: {e}"}
+    budget.record({"fused_ab": fused_ab})
+    # -- measured attribution + calibrated roofline: trace k steady
+    # steps, classify device op time per phase, and (on backends whose
+    # trace carries memory counters) calibrate hbm_bytes_per_step
+    # against MEASURED bytes instead of the cost_analysis model
+    # (doc/ibn_perf.md; tools/ibn_perf.py regenerates the doc table)
+    if budget.low(75, "attribution"):
+        att = {"skipped": "budget"}
+    else:
+        att = profile_attribution(tr, classes, batch,
+                                  k=8 if on_accel else 3)
+    budget.record({"attribution": att})
+    analytic = analytic_step_bytes(tr, batch)
+    # trace bytes sum over ALL device planes (whole module) while
+    # c["hbm_bytes_per_step"] is per-chip on multi-chip meshes — scale
+    # the measured side to per-chip so the ratio compares like units
+    meas = att.get("measured_bytes_per_step")
+    if meas:
+        meas = meas / max(1, c["n_chips"])
+    # per-chip analytic share: activations split across the data axis,
+    # the replicated param/optimizer passes run on every chip
+    analytic_pc = (analytic["activation_bytes"] / max(1, c["n_chips"])
+                   + analytic["param_bytes"])
+    calib = calibration_entry(c["hbm_bytes_per_step"], meas, analytic_pc)
+    budget.record({"calibration": calib})
+    # -- input_fold (second kernel wave, this round): uint8 batches
+    # normalize IN-STEP — cost-analysis bytes of the folded step vs the
+    # f32-input step + the eager normalize it deletes
+    if budget.low(60, "input_fold"):
+        fold_entry = {"skipped": "budget"}
+    elif c["n_chips"] > 1:
+        # raw step_cost_analysis bytes are whole-module while the
+        # headline bytes may be per-chip-normalized — the comparison
+        # is only like-for-like on one chip (the standard bench rig)
+        fold_entry = {"skipped": "multi-chip (byte units ambiguous; "
+                                 "single-chip runs carry this)"}
+    else:
+        fold_entry = input_fold_entry(tr, c, image, classes, batch)
+    budget.record({"input_fold": fold_entry})
     # bf16-vs-fp32 as a measured RATIO in the same JSON line: the
     # flagship conf computes in bf16 (gen_inception_bn emits
     # compute_dtype = bfloat16), so one fp32-policy rerun of the same
@@ -861,19 +1082,22 @@ def main() -> None:
                 round(100.0 * ach / 1e12 / c["peak_bf16_tflops"], 2)
                 if c["peak_bf16_tflops"] else 0.0)
     # float path: per-batch dispatch — equally link-bound (doc/
-    # e2e_input.md) and a second chain compile would buy nothing
-    if budget.low(60, "e2e_f32"):
+    # e2e_input.md) and a second chain compile would buy nothing.
+    # --full only (with decode/h2d below): the default run is
+    # time-boxed to ONE uint8 e2e window (ROADMAP 5b / VERDICT r5 #4)
+    skip_marker = None if args.full else "--full only"
+    if skip_marker or budget.low(60, "e2e_f32"):
         e2e_ips = None
     else:
         e2e_ips, _ = e2e_bench(tr, image, classes, batch,
                                max(4, e2e_steps // 3), chain=0)
         budget.record({"e2e_images_per_sec_per_chip": round(e2e_ips, 2)})
-    if budget.low(45, "decode_pool"):
+    if skip_marker or budget.low(45, "decode_pool"):
         dec = None
     else:
         dec = decode_bench(image=image if on_accel else 64,
                            n_img=256 if on_accel else 64)
-    if budget.low(15, "h2d"):
+    if skip_marker or budget.low(15, "h2d"):
         h2d = None
     else:
         h2d = h2d_bench(image, batch)
@@ -1018,12 +1242,22 @@ def main() -> None:
         "e2e_u8_images_per_sec_per_chip":
             None if e2e_u8 is None else round(e2e_u8, 2),
         "e2e_attribution": e2e_detail,
-        "h2d": h2d if h2d is not None else {"skipped": "budget"},
-        "decode_pool": dec if dec is not None else {"skipped": "budget"},
+        "h2d": h2d if h2d is not None
+        else {"skipped": skip_marker or "budget"},
+        "decode_pool": dec if dec is not None
+        else {"skipped": skip_marker or "budget"},
         "loss_start": round(c["loss_start"], 4),
         "loss_end": round(c["loss_end"], 4),
         "fp32_compare": fp32_cmp,
+        # fused_kernels=1 vs 0 flagship A/B, measured per-phase
+        # attribution, and the measured-vs-cost_analysis byte
+        # calibration — the ROADMAP item-1 trio, all in one artifact
+        "fused_ab": fused_ab,
+        "attribution": att,
+        "calibration": calib,
+        "input_fold": fold_entry,
         "models": models,
+        "bench_mode": "full" if args.full else "quick",
         "budget_s": args.budget_s,
     })
 

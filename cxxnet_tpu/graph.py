@@ -342,6 +342,32 @@ def act_fusion_plan(graph: NetGraph):
     return fuse_act, folded
 
 
+def stem_pad_plan(graph: NetGraph, pad_to: int = 4) -> Dict[int, int]:
+    """Stem channel-padding plan (second kernel wave, doc/ibn_perf.md):
+    conv layers reading the RAW graph input with fewer than ``pad_to``
+    channels get their input (and the matching weight dim) zero-padded
+    to ``pad_to`` at apply time. RGB stems leave 125 of the MXU's 128
+    systolic rows idle; padding 3 -> 4 makes the channel dim (and the
+    space-to-depth fold's s*s*cin product) a power-of-two lane/sublane
+    multiple. Value-exact: zero input channels times zero weight taps
+    contribute nothing, and the traced pad's transpose is a slice, so
+    gradients to the canonical-shape weights are unchanged.
+
+    Returns {layer_index: pad_to} — only first-layer convs qualify
+    (deeper channel counts are layer-controlled and already large).
+    """
+    plan: Dict[int, int] = {}
+    if graph.input_shape is None or pad_to <= 0:
+        return plan
+    if graph.input_shape[0] >= pad_to:
+        return plan
+    for li, spec in enumerate(graph.layers):
+        if (spec.type == "conv" and not spec.is_shared
+                and spec.nindex_in == [0]):
+            plan[li] = pad_to
+    return plan
+
+
 def global_param(cfg: ConfigPairs, name: str, default: str = "") -> str:
     """Last-wins lookup of a global setting (CLI overrides come last)."""
     out = default

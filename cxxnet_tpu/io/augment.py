@@ -126,11 +126,86 @@ class AugmentParams:
 
 
 def mean_cache_path(p: AugmentParams) -> str:
-    """Path of the cached mean image (.npy suffix appended when absent)."""
+    """Path of the cached mean image (.npy suffix appended when absent;
+    ``.binaryproto`` paths pass through — Caffe mean import)."""
     path = p.mean_img
-    if path and not path.endswith(".npy"):
+    if path and not path.endswith((".npy", ".binaryproto")):
         path = path + ".npy"
     return path
+
+
+def load_binaryproto_mean(data: bytes, rgb_flip: bool = True) -> np.ndarray:
+    """Parse a Caffe ``mean.binaryproto`` (a serialized BlobProto) into
+    an (H, W, C) float32 RGB mean image — the classic ImageNet
+    preprocessing artifact (reference tools/caffe_converter). Wire-level
+    protobuf parsing via the repo's shared minimal reader
+    (telemetry.traceparse.iter_fields) — no Caffe/protobuf dependency.
+    Caffe blobs are NCHW with BGR channel order; ``rgb_flip`` (default)
+    reverses the channel axis so the result matches this framework's
+    RGB pipeline.
+
+    BlobProto fields used: legacy dims num=1 channels=2 height=3
+    width=4, payload ``data`` (repeated float, field 5, packed or not),
+    new-style ``shape`` (field 7: BlobShape{repeated int64 dim=1})."""
+    from ..telemetry.traceparse import iter_fields, read_varint
+
+    legacy = {1: 0, 2: 0, 3: 0, 4: 0}
+    shape: list = []
+    chunks: list = []
+    for field, wt, val in iter_fields(data):
+        if wt == 0 and field in legacy:
+            legacy[field] = val
+        elif field == 5 and wt == 5:            # unpacked float
+            chunks.append(np.frombuffer(val, "<f4"))
+        elif field == 5 and wt == 2:            # packed floats
+            chunks.append(np.frombuffer(val, "<f4"))
+        elif field == 7 and wt == 2:            # BlobShape
+            for f2, wt2, v2 in iter_fields(val):
+                if f2 != 1:
+                    continue
+                if wt2 == 0:
+                    shape.append(v2)
+                elif wt2 == 2:                  # packed dims
+                    p = 0
+                    while p < len(v2):
+                        d, p = read_varint(v2, p)
+                        shape.append(d)
+    arr = (np.concatenate(chunks) if chunks
+           else np.zeros((0,), np.float32))
+    if not shape:
+        shape = [d for d in (legacy[1], legacy[2], legacy[3], legacy[4])
+                 if d]
+    if not shape or int(np.prod(shape)) != arr.size:
+        raise ValueError(
+            f"binaryproto: shape {shape} does not match {arr.size} floats")
+    arr = arr.reshape(shape)
+    while arr.ndim > 3 and arr.shape[0] == 1:   # (1,C,H,W) -> (C,H,W)
+        arr = arr[0]
+    if arr.ndim != 3:
+        raise ValueError(f"binaryproto: expected a CHW mean, got "
+                         f"{arr.shape}")
+    arr = np.transpose(arr, (1, 2, 0))          # CHW -> HWC
+    if rgb_flip and arr.shape[-1] == 3:
+        arr = arr[:, :, ::-1]                   # BGR -> RGB
+    return np.ascontiguousarray(arr, np.float32)
+
+
+def _center_crop_mean(mean: np.ndarray,
+                      shape_hwc: Tuple[int, int, int]) -> np.ndarray:
+    """Caffe means are usually computed at the resize size (e.g.
+    256x256) while this pipeline subtracts post-crop (e.g. 224x224):
+    center-crop the imported mean to the input shape — the standard
+    Caffe deploy-time treatment of the mean blob."""
+    h, w, c = shape_hwc
+    mh, mw = mean.shape[:2]
+    if (mh, mw) == (h, w):
+        return mean
+    if mh < h or mw < w or mean.shape[2] != c:
+        raise ValueError(
+            f"mean image {mean.shape} incompatible with input "
+            f"({h}, {w}, {c}); it must be at least the crop size")
+    y0, x0 = (mh - h) // 2, (mw - w) // 2
+    return np.ascontiguousarray(mean[y0:y0 + h, x0:x0 + w])
 
 
 def pack_label(labels, width: int) -> np.ndarray:
@@ -289,8 +364,16 @@ class MeanStore:
         self.mean: Optional[np.ndarray] = None
         from . import stream
         if path and stream.exists(path):
-            with stream.sopen(path, "rb") as f:
-                self.mean = np.load(f)
+            if path.endswith(".binaryproto"):
+                # Caffe mean import (VERDICT r5 #6): parse the BlobProto
+                # at the wire level, BGR->RGB, center-crop the (usually
+                # resize-sized) mean to the input crop
+                with stream.sopen(path, "rb") as f:
+                    mean = load_binaryproto_mean(f.read())
+                self.mean = _center_crop_mean(mean, shape_hwc)
+            else:
+                with stream.sopen(path, "rb") as f:
+                    self.mean = np.load(f)
 
     @property
     def ready(self) -> bool:
@@ -298,6 +381,12 @@ class MeanStore:
 
     def compute(self, images) -> None:
         """images: iterable of (out_y, out_x, c) float arrays."""
+        if self.path.endswith(".binaryproto"):
+            raise ValueError(
+                f"mean file {self.path!r} not found; .binaryproto means "
+                "are imported, never computed — convert with "
+                "tools/import_caffe.py --mean or point image_mean at a "
+                ".npy path")
         acc = np.zeros(self.shape, np.float64)
         n = 0
         for im in images:

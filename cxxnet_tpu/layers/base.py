@@ -157,6 +157,12 @@ class ApplyCtx:
     # MUST apply the activation on their reference path too — the fold
     # is decided statically, kernel selection per trace.
     fuse_act: Optional[str] = None
+    # stem channel padding (graph.stem_pad_plan): pad this conv's input
+    # channels (and the matching weight dim) with zeros up to this count
+    # at apply time — value-exact (zero channels x zero taps contribute
+    # nothing; the pad/slice pair transposes exactly under autodiff),
+    # params/checkpoints keep the canonical shape. None = no pad.
+    cin_pad: Optional[int] = None
 
 
 class Layer:

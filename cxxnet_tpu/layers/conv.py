@@ -82,6 +82,15 @@ class ConvolutionLayer(Layer):
         hp = self.hp
         x = inputs[0].astype(ctx.compute_dtype)
         w = params["wmat"].astype(ctx.compute_dtype)
+        # stem channel padding (graph.stem_pad_plan via ctx.cin_pad):
+        # zero-pad the input channels and the weight's I dim together —
+        # exact (0 * 0 taps), params keep canonical shape, and the s2d
+        # fold below then packs s*s*cin_pad channels
+        if (ctx.cin_pad and hp.num_group == 1
+                and x.shape[-1] < ctx.cin_pad):
+            padc = ctx.cin_pad - x.shape[-1]
+            x = jnp.pad(x, ((0, 0), (0, 0), (0, 0), (0, padc)))
+            w = jnp.pad(w, ((0, 0), (0, 0), (0, padc), (0, 0)))
         # compute-dtype in, compute-dtype out: the MXU accumulates bf16
         # matmuls in f32 internally, and keeping activations in bf16
         # halves HBM traffic (mixed preferred_element_type would also break
@@ -205,6 +214,20 @@ class _PoolingLayer(Layer):
     def apply(self, params, state, inputs, ctx):
         hp = self.hp
         x = inputs[0]
+        if ctx.fused:
+            # fused pooling kernel (ops/fused_pool.py): non-overlapping
+            # and global-window geometries in one VMEM pass with a
+            # fused backward (no select-and-scatter); pre_relu folds
+            # in. None -> unsupported geometry, reduce_window below.
+            from ..ops.fused_pool import fused_pool
+            fy = fused_pool(
+                x, kh=hp.kernel_height, kw=hp.kernel_width,
+                stride=hp.stride, pad=(hp.pad_y, hp.pad_x),
+                extra=(self._extra_y, self._extra_x),
+                reducer="max" if self.reducer == "max" else "sum",
+                scale_avg=self.scale_avg, pre_relu=self.pre_relu)
+            if fy is not None:
+                return [fy], state
         if self.pre_relu:
             x = jax.nn.relu(x)
         if self.reducer == "max":

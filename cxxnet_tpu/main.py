@@ -449,6 +449,7 @@ class LearnTask:
             end_round = min(end_round, self.start_counter + self.max_round)
         self._end_round = end_round
         self._sentinel_tick = 0
+        self._profile_summarized = False
         if self.sentinel_on and not self.test_io:
             if not 0.0 < self.lr_backoff <= 1.0:
                 raise ValueError(
@@ -563,6 +564,21 @@ class LearnTask:
                       f"{dt:.2f} sec = {n_images / dt:.1f} images/sec",
                       flush=True)
                 continue
+            if (profiler is not None and profiler.done
+                    and not self._profile_summarized):
+                # the telemetry_profile_steps bracket closed this round:
+                # print the measured per-phase attribution (traceparse)
+                # instead of leaving the dump for offline xprof. Root
+                # only — non-root ranks must not pay the dump parse for
+                # a line they never print.
+                self._profile_summarized = True
+                att = profiler.summarize() if self._is_root else None
+                if att is not None:
+                    from .telemetry.traceparse import attribution_fragment
+                    frag = attribution_fragment(att)
+                    if frag:
+                        print(f"round {r:8d}: {frag} "
+                              f"(dump: {profiler.dump_dir})", flush=True)
             line = f"round {r:8d}:[{int(time.time() - start)} sec]"
             if tr.eval_train:
                 line += tr.train_metric_report("train")

@@ -106,6 +106,21 @@ class Network:
             self._fuse_act, self._act_folded = act_fusion_plan(graph)
         else:
             self._fuse_act, self._act_folded = {}, set()
+        # stem channel padding (graph.stem_pad_plan): value-exact, so on
+        # by default; stem_pad = 0 disables, stem_pad = N (>= 2)
+        # overrides the pad-to width (default 4 — lane/sublane-friendly
+        # for the RGB stem and its space-to-depth fold). "1"/"on" mean
+        # ON at the default width, matching the sibling knobs'
+        # (fused_kernels, input_fold) auto|1|0 grammar — a width of 1
+        # could never pad anything and silently-off would invert the
+        # user's intent.
+        sp = global_param(cfg, "stem_pad", "auto").strip().lower()
+        if sp in ("0", "off", "false", "no"):
+            self._cin_pad = {}
+        else:
+            from .graph import stem_pad_plan
+            pad_to = int(sp) if sp.isdigit() and int(sp) >= 2 else 4
+            self._cin_pad = stem_pad_plan(graph, pad_to=pad_to)
 
     def _fused_now(self) -> bool:
         """Per-trace fused-kernel decision: knob/env x backend (ops.
@@ -183,7 +198,8 @@ class Network:
                            compute_dtype=cdt,
                            seq_axis=seq_axis, data_axis=data_axis,
                            fused=fused_now,
-                           fuse_act=self._fuse_act.get(li))
+                           fuse_act=self._fuse_act.get(li),
+                           cin_pad=self._cin_pad.get(li))
             inputs = [nodes[ni] for ni in spec.nindex_in]
             lparams = params.get(layer.name, {})
             lstate = new_state.get(layer.name, {})
@@ -194,7 +210,8 @@ class Network:
                                  seq_axis=_ctx.seq_axis,
                                  data_axis=_ctx.data_axis,
                                  fused=_ctx.fused,
-                                 fuse_act=_ctx.fuse_act)
+                                 fuse_act=_ctx.fuse_act,
+                                 cin_pad=_ctx.cin_pad)
                     return _layer.apply(lp, ls, list(ins), c)
                 outputs, lstate_out = jax.checkpoint(_fn)(
                     lparams, lstate, ctx.rng, *inputs)

@@ -49,12 +49,19 @@ class StepProfiler:
         self.dump_dir = dump_dir
         self.active = False
         self.done = False
+        self._bracket = None
 
     def maybe_start(self, step: int) -> None:
         if self.done or self.active or step < self.start_step:
             return
-        import jax
-        jax.profiler.start_trace(self.dump_dir)
+        # device_trace, not jax.profiler.start_trace: the bracket's
+        # primary consumer is now summarize()'s attribution, and a
+        # python-traced flagship step floods the profiler's event cap
+        # with interpreter frames, evicting the very op events the
+        # table reads (device/HLO activity still lands for xprof)
+        from .traceparse import device_trace
+        self._bracket = device_trace(self.dump_dir)
+        self._bracket.__enter__()
         self.active = True
         TRACER.instant("profiler.start_trace", cat="profile",
                        args={"step": step, "dir": self.dump_dir})
@@ -73,7 +80,8 @@ class StepProfiler:
                 jax.block_until_ready(ready)
             except Exception:
                 pass
-        jax.profiler.stop_trace()
+        self._bracket.__exit__(None, None, None)
+        self._bracket = None
         self.active = False
         self.done = True
         TRACER.instant("profiler.stop_trace", cat="profile",
@@ -82,3 +90,19 @@ class StepProfiler:
     def close(self, ready: Any = None) -> None:
         if self.active:
             self._stop(ready)
+
+    def summarize(self) -> Optional[dict]:
+        """Per-phase attribution of the bracketed steps (traceparse) —
+        None until the bracket has closed or when the dump is
+        unparseable. The driver prints ``attribution_fragment`` of this
+        after the bracket closes, turning the profile knob that used to
+        require offline xprof into an in-run phase table."""
+        if not self.done:
+            return None
+        from .traceparse import attribute_profile
+        try:
+            return attribute_profile(
+                self.dump_dir,
+                steps=self.stop_step - self.start_step + 1)
+        except Exception:
+            return None

@@ -1,0 +1,503 @@
+"""Profiler-trace parsing + per-phase attribution for the flagship step.
+
+`jax.profiler.start_trace` dumps `plugins/profile/<ts>/` containing an
+``*.xplane.pb`` (the XSpace protobuf — the ground truth, carrying per-op
+stats like ``bytes accessed`` on TPU) and usually a ``*.trace.json.gz``
+(the Chrome-trace rendering of the same events). Both are parsed here
+without any protobuf/tensorflow dependency: the xplane reader walks the
+wire format directly (the tools/import_caffe.py technique) and the json
+reader is plain ``json``.
+
+The output of :func:`attribute_profile` is the measured analog of the
+cost-analysis *model* the bench has carried since round 2: device-side
+op events classified into the phases of the Inception-BN step
+(conv / bn_act / pool / lrn / matmul / optimizer / h2d / other), with
+per-phase time shares and — when the backend records them — measured
+HBM bytes, so ``hbm_bytes_per_step`` can finally be calibrated against
+a chip number instead of XLA's pre-fusion estimate (ROADMAP item 1,
+doc/ibn_perf.md).
+
+Phase classification is heuristic by construction: XLA names fusions
+after their constituent ops (``tanh_reduce_fusion``) or anonymously
+(``fusion.123``); anonymous events fall into ``other`` (reported with
+their top names) rather than being guessed at. TPU xplanes additionally
+carry an ``hlo_category`` stat which, when present, is trusted over the
+name heuristic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import gzip
+import json
+import os
+from typing import Dict, Iterator, List, Optional, Tuple
+
+# ---- minimal protobuf wire-format reader (tools/import_caffe.py idiom) ----
+
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    out = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+
+
+def _iter_fields(buf: bytes) -> Iterator[Tuple[int, int, object]]:
+    """Yield (field_number, wire_type, value) for one message's bytes."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = _read_varint(buf, pos)
+        field, wt = key >> 3, key & 7
+        if wt == 0:
+            val, pos = _read_varint(buf, pos)
+        elif wt == 1:
+            val, pos = buf[pos:pos + 8], pos + 8
+        elif wt == 2:
+            ln, pos = _read_varint(buf, pos)
+            val, pos = buf[pos:pos + ln], pos + ln
+        elif wt == 5:
+            val, pos = buf[pos:pos + 4], pos + 4
+        else:
+            raise ValueError(f"unsupported protobuf wire type {wt}")
+        yield field, wt, val
+
+
+def _signed(v: int) -> int:
+    """Two's-complement int64 view of a varint value."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+#: public aliases — the ONE minimal wire reader shared across the repo
+#: (io/augment's binaryproto mean import reuses these; only the
+#: standalone tools/import_caffe.py keeps its own copy, being a
+#: no-package-import CLI)
+read_varint = _read_varint
+iter_fields = _iter_fields
+
+
+# ---- XSpace structure (tensorflow/tsl/profiler/protobuf/xplane.proto) ----
+#
+# XSpace  { repeated XPlane planes = 1 }
+# XPlane  { id=1 name=2 lines=3 event_metadata=4(map) stat_metadata=5(map) }
+# XLine   { id=1 name=2 timestamp_ns=3 events=4 display_name=11 }
+# XEvent  { metadata_id=1 offset_ps=2 duration_ps=3 stats=4 }
+# XStat   { metadata_id=1 double=2 uint64=3 int64=4 bytes=5 ref=6 }
+# X*Metadata { id=1 name=2 }  map entries: { key=1 value=2 }
+
+
+@dataclasses.dataclass
+class OpEvent:
+    """One aggregated device-side op: total duration + summed stats."""
+    name: str
+    dur_ps: int
+    count: int = 1
+    stats: Dict[str, float] = dataclasses.field(default_factory=dict)
+    category: str = ""
+
+
+def _parse_metadata_map(buf: bytes) -> Dict[int, str]:
+    """map<int64, X{Event,Stat}Metadata> entry -> {id: name}."""
+    out: Dict[int, str] = {}
+    key = None
+    meta_id, name = 0, ""
+    for field, wt, val in _iter_fields(buf):
+        if field == 1 and wt == 0:
+            key = val
+        elif field == 2 and wt == 2:
+            for f2, wt2, v2 in _iter_fields(val):
+                if f2 == 1 and wt2 == 0:
+                    meta_id = v2
+                elif f2 == 2 and wt2 == 2:
+                    name = v2.decode("utf-8", "replace")
+    out[key if key is not None else meta_id] = name
+    return out
+
+
+def _parse_stat(buf: bytes, stat_names: Dict[int, str]):
+    """XStat -> (name, value) with numeric values preferred."""
+    import struct
+    mid, value = 0, None
+    for field, wt, val in _iter_fields(buf):
+        if field == 1 and wt == 0:
+            mid = val
+        elif field == 2 and wt == 1:
+            value = struct.unpack("<d", val)[0]
+        elif field == 3 and wt == 0:
+            value = float(val)
+        elif field == 4 and wt == 0:
+            value = float(_signed(val))
+        elif field == 5 and wt == 2:
+            value = val.decode("utf-8", "replace")
+        elif field == 6 and wt == 0:
+            value = val          # ref into stat_metadata (string table)
+    name = stat_names.get(mid, str(mid))
+    if isinstance(value, int):   # ref_value: resolve through the table
+        value = stat_names.get(value, str(value))
+    return name, value
+
+
+def parse_xplane(path: str) -> List[dict]:
+    """Parse an ``*.xplane.pb`` into
+    ``[{"name", "lines": [{"name", "events": [OpEvent-per-occurrence]}]}]``.
+    Events are NOT aggregated here (the golden test wants raw structure);
+    :func:`_collect_op_events` aggregates."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    planes = []
+    for field, wt, val in _iter_fields(buf):
+        if field != 1 or wt != 2:
+            continue
+        plane = {"name": "", "lines": []}
+        event_names: Dict[int, str] = {}
+        stat_names: Dict[int, str] = {}
+        raw_lines: List[bytes] = []
+        for f2, wt2, v2 in _iter_fields(val):
+            if f2 == 2 and wt2 == 2:
+                plane["name"] = v2.decode("utf-8", "replace")
+            elif f2 == 3 and wt2 == 2:
+                raw_lines.append(v2)
+            elif f2 == 4 and wt2 == 2:
+                event_names.update(_parse_metadata_map(v2))
+            elif f2 == 5 and wt2 == 2:
+                stat_names.update(_parse_metadata_map(v2))
+        for lv in raw_lines:
+            line = {"name": "", "events": []}
+            for f3, wt3, v3 in _iter_fields(lv):
+                if f3 == 2 and wt3 == 2:
+                    line["name"] = v3.decode("utf-8", "replace")
+                elif f3 == 4 and wt3 == 2:
+                    mid, dur = 0, 0
+                    stats: Dict[str, float] = {}
+                    for f4, wt4, v4 in _iter_fields(v3):
+                        if f4 == 1 and wt4 == 0:
+                            mid = v4
+                        elif f4 == 3 and wt4 == 0:
+                            dur = v4
+                        elif f4 == 4 and wt4 == 2:
+                            k, v = _parse_stat(v4, stat_names)
+                            if v is not None:
+                                stats[k] = v
+                    line["events"].append(OpEvent(
+                        name=event_names.get(mid, str(mid)), dur_ps=dur,
+                        stats=stats,
+                        category=str(stats.get("hlo_category", ""))))
+            plane["lines"].append(line)
+        planes.append(plane)
+    return planes
+
+
+def parse_trace_json(path: str) -> List[dict]:
+    """``*.trace.json(.gz)`` -> planes in the same shape as
+    :func:`parse_xplane` (pid = plane, tid = line; durations in ps)."""
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        doc = json.loads(f.read().decode("utf-8", "replace"))
+    pid_names: Dict[int, str] = {}
+    by_pid: Dict[int, List[OpEvent]] = {}
+    for e in doc.get("traceEvents", []):
+        ph = e.get("ph")
+        if ph == "M" and e.get("name") == "process_name":
+            pid_names[e.get("pid")] = e.get("args", {}).get("name", "")
+        elif ph == "X":
+            args = e.get("args", {}) or {}
+            stats = {k: v for k, v in args.items()}
+            by_pid.setdefault(e.get("pid"), []).append(OpEvent(
+                name=e.get("name", ""),
+                dur_ps=int(float(e.get("dur", 0.0)) * 1e6),  # us -> ps
+                stats=stats,
+                category=str(args.get("hlo_category", ""))))
+    return [{"name": pid_names.get(pid, str(pid)),
+             "lines": [{"name": "", "events": evs}]}
+            for pid, evs in by_pid.items()]
+
+
+def find_profile_files(dump_dir: str) -> Dict[str, Optional[str]]:
+    """Newest ``plugins/profile/<ts>`` dump under ``dump_dir`` -> paths
+    of the xplane / trace.json artifacts (either may be None)."""
+    runs = sorted(glob.glob(os.path.join(
+        dump_dir, "plugins", "profile", "*")))
+    out: Dict[str, Optional[str]] = {"xplane": None, "trace_json": None}
+    if not runs:
+        return out
+    run = runs[-1]
+    xp = sorted(glob.glob(os.path.join(run, "*.xplane.pb")))
+    tj = sorted(glob.glob(os.path.join(run, "*.trace.json.gz"))) or \
+        sorted(glob.glob(os.path.join(run, "*.trace.json")))
+    out["xplane"] = xp[0] if xp else None
+    out["trace_json"] = tj[0] if tj else None
+    return out
+
+
+# ---- phase classification ---------------------------------------------------
+
+#: ordered (phase, name substrings) — first match wins. Backward conv ops
+#: are still "conv"; XLA-fused elementwise chains that kept an op kind in
+#: their name classify by it; anonymous fusions land in "other".
+PHASE_RULES: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
+    ("h2d", ("copy", "transfer", "infeed", "outfeed", "h2d", "d2h",
+             "memcpy", "reshard", "device_put")),
+    ("optim", ("fused_optim", "multi_tensor", "optimizer", "sgd_",
+               "adam", "nag_", "apply_grad")),
+    ("lrn", ("lrn",)),
+    ("pool", ("reduce-window", "reduce_window", "select-and-scatter",
+              "select_and_scatter", "pool")),
+    ("conv", ("conv",)),
+    ("matmul", ("dot", "gemm", "matmul", "einsum")),
+    ("bn_act", ("bn_fwd", "bn_bwd", "_bn_", "batch-norm", "batchnorm",
+                "batch_norm", "rsqrt", "norm", "relu", "stem",
+                "decode_normalize", "epilogue", "bias_act")),
+)
+
+#: TPU ``hlo_category`` stat values -> phase (trusted over the name rules)
+CATEGORY_RULES: Tuple[Tuple[str, str], ...] = (
+    ("convolution", "conv"),
+    ("conv", "conv"),
+    ("reduce window", "pool"),
+    ("select and scatter", "pool"),
+    ("matmul", "matmul"),
+    ("dot", "matmul"),
+    ("data formatting", "h2d"),
+    ("copy", "h2d"),
+    ("infeed", "h2d"),
+    ("outfeed", "h2d"),
+)
+
+#: the table ordering for doc/ibn_perf.md (h2d last, other at the end)
+PHASE_ORDER = ("conv", "bn_act", "pool", "lrn", "matmul", "optim",
+               "h2d", "other")
+
+
+def classify_op(name: str, category: str = "") -> str:
+    """Classify one device op event into a step phase."""
+    cat = (category or "").lower()
+    if cat:
+        for key, phase in CATEGORY_RULES:
+            if key in cat:
+                return phase
+    low = (name or "").lower()
+    for phase, pats in PHASE_RULES:
+        for p in pats:
+            if p in low:
+                return phase
+    return "other"
+
+
+# runtime/bookkeeping events that are not device op work — excluded from
+# attribution (they time the host driving the device, not the step)
+_RUNTIME_MARKERS = (
+    "pjitfunction", "executehelper", "tfrtcpu", "threadpoollistener",
+    "thunkexecutor", "parsearguments", "start_trace", "stop_trace",
+    "__exit__", "profiler.py", "buffer::", "program_interpreter",
+    "xla launch", "stream::", "run graph",
+)
+
+
+#: control-flow CONTAINER ops (their duration includes their children,
+#: which appear as their own events — counting both double-attributes)
+_CONTAINER_PREFIXES = ("while", "conditional", "call")
+
+
+def _is_op_event(ev: OpEvent) -> bool:
+    low = ev.name.lower()
+    if low.startswith("$"):      # python-tracer frames, never op work
+        return False
+    if any(m in low for m in _RUNTIME_MARKERS):
+        return False
+    if any(low.startswith(p) for p in _CONTAINER_PREFIXES):
+        return False
+    # op events are either tagged by the profiler (hlo_op/hlo_module —
+    # the CPU backend's convention) or live on a device plane whose
+    # events the caller already filtered
+    return True
+
+
+def _collect_op_events(planes: List[dict]) -> Tuple[List[OpEvent], str]:
+    """Pick the planes/lines holding device-side op events and aggregate
+    by op name. Preference: planes named like an accelerator device;
+    fallback: any event carrying an ``hlo_op``/``hlo_module`` stat (the
+    CPU backend reports op events on host Eigen threads)."""
+    device = [p for p in planes
+              if "/device:" in p["name"].lower()
+              and "sparsecore" not in p["name"].lower()]
+    chosen: List[OpEvent] = []
+    where = ""
+    if device:
+        where = ",".join(p["name"] for p in device)
+        for p in device:
+            lines = [l for l in p["lines"]
+                     if "step" not in l["name"].lower()
+                     and "module" not in l["name"].lower()]
+            for l in lines:
+                chosen.extend(e for e in l["events"] if _is_op_event(e))
+    else:
+        where = "host hlo events"
+        for p in planes:
+            for l in p["lines"]:
+                chosen.extend(
+                    e for e in l["events"]
+                    if ("hlo_op" in e.stats or "hlo_module" in e.stats)
+                    and _is_op_event(e))
+    agg: Dict[str, OpEvent] = {}
+    for e in chosen:
+        cur = agg.get(e.name)
+        if cur is None:
+            agg[e.name] = OpEvent(name=e.name, dur_ps=e.dur_ps, count=1,
+                                  stats=dict(e.stats),
+                                  category=e.category)
+        else:
+            cur.dur_ps += e.dur_ps
+            cur.count += 1
+            for k, v in e.stats.items():
+                if isinstance(v, (int, float)):
+                    prev = cur.stats.get(k, 0.0)
+                    if isinstance(prev, (int, float)):
+                        cur.stats[k] = prev + v
+    return list(agg.values()), where
+
+
+_BYTES_STAT_NAMES = ("bytes accessed", "bytes_accessed")
+_FLOPS_STAT_NAMES = ("flops", "model_flops")
+
+
+class device_trace:
+    """Context manager: a profiler bracket tuned for ATTRIBUTION —
+    python tracer OFF so the (capped) event buffer holds device/HLO op
+    events instead of millions of interpreter frames (a python-traced
+    flagship step evicts every op event and the attribution reads
+    empty). Falls back to the plain ``jax.profiler`` bracket when the
+    backing ``ProfileOptions`` API is unavailable."""
+
+    def __init__(self, log_dir: str):
+        self.log_dir = log_dir
+        self._session = None
+        self._fallback = False
+
+    def __enter__(self):
+        try:
+            from jax._src.lib import xla_client
+            opts = xla_client.profiler.ProfileOptions()
+            opts.python_tracer_level = 0
+            self._session = xla_client.profiler.ProfilerSession(opts)
+        except Exception:
+            import jax
+            self._fallback = True
+            jax.profiler.start_trace(self.log_dir)
+        return self
+
+    def __exit__(self, *exc):
+        if self._fallback:
+            import jax
+            jax.profiler.stop_trace()
+        elif self._session is not None:
+            self._session.stop_and_export(self.log_dir)
+        return False
+
+
+def attribute_profile(dump_dir: str, steps: int = 1) -> dict:
+    """Parse the newest profile dump under ``dump_dir`` and attribute
+    op time (and, when recorded, HBM bytes) to step phases.
+
+    Returns::
+
+        {"phases": {phase: {"ms": per-step, "pct": share-of-op-time,
+                            "count": events}},
+         "total_op_ms": per-step summed op time,
+         "measured_bytes_per_step": int | None,   # trace memory counters
+         "measured_flops_per_step": float | None,
+         "top_other": [(name, ms), ...],          # unclassified heavies
+         "steps": steps, "source": "xplane"|"trace_json",
+         "device": plane-name note}
+
+    Summed op time can exceed wall time on parallel backends (CPU thread
+    pools overlap ops) — shares are of summed op time, which is the
+    honest attribution basis either way. Raises ``FileNotFoundError``
+    when no dump exists; a malformed dump degrades to the other format
+    before failing.
+    """
+    files = find_profile_files(dump_dir)
+    planes = None
+    source = None
+    errors = []
+    for key, parser in (("xplane", parse_xplane),
+                        ("trace_json", parse_trace_json)):
+        if files[key] is None:
+            continue
+        try:
+            planes = parser(files[key])
+            source = key
+            events, where = _collect_op_events(planes)
+            if events:
+                break
+        except Exception as e:           # fall through to the other format
+            errors.append(f"{key}: {type(e).__name__}: {e}")
+            planes = None
+    if planes is None:
+        raise FileNotFoundError(
+            f"no parseable profile dump under {dump_dir!r}"
+            + (f" ({'; '.join(errors)})" if errors else ""))
+    steps = max(1, int(steps))
+    phases: Dict[str, Dict[str, float]] = {}
+    other: List[Tuple[str, float]] = []
+    total_ps = 0
+    bytes_total = 0.0
+    flops_total = 0.0
+    have_bytes = have_flops = False
+    for ev in events:
+        phase = classify_op(ev.name, ev.category)
+        ms = ev.dur_ps / 1e9
+        total_ps += ev.dur_ps
+        d = phases.setdefault(phase, {"ms": 0.0, "pct": 0.0, "count": 0})
+        d["ms"] += ms
+        d["count"] += ev.count
+        if phase == "other":
+            other.append((ev.name, ms))
+        for k in _BYTES_STAT_NAMES:
+            v = ev.stats.get(k)
+            if isinstance(v, (int, float)) and v > 0:
+                bytes_total += v
+                have_bytes = True
+                break
+        for k in _FLOPS_STAT_NAMES:
+            v = ev.stats.get(k)
+            if isinstance(v, (int, float)) and v > 0:
+                flops_total += v
+                have_flops = True
+                break
+    total_ms = total_ps / 1e9
+    for d in phases.values():
+        d["pct"] = 100.0 * d["ms"] / total_ms if total_ms else 0.0
+        d["ms"] = d["ms"] / steps
+    other.sort(key=lambda kv: -kv[1])
+    return {
+        "phases": phases,
+        "total_op_ms": total_ms / steps,
+        "measured_bytes_per_step": (bytes_total / steps
+                                    if have_bytes else None),
+        "measured_flops_per_step": (flops_total / steps
+                                    if have_flops else None),
+        "top_other": [(n, ms / steps) for n, ms in other[:8]],
+        "steps": steps,
+        "source": source,
+        "device": where,
+    }
+
+
+def attribution_fragment(att: dict) -> str:
+    """One-line round-log rendering of an attribution (main.py prints it
+    after a telemetry_profile_steps bracket closes)."""
+    parts = []
+    for phase in PHASE_ORDER:
+        d = att["phases"].get(phase)
+        if d:
+            parts.append(f"{phase}:{d['ms']:.2f}ms({d['pct']:.0f}%)")
+    extra = ""
+    if att.get("measured_bytes_per_step"):
+        extra = f" hbm={att['measured_bytes_per_step'] / 1e9:.2f}GB/step"
+    return ("profile[" + " ".join(parts) + "]" + extra) if parts else ""

@@ -53,6 +53,22 @@ def _collect_nodes(res, needed):
     return nodes
 
 
+def _fold_input(data, net):
+    """input_fold entry point inside the compiled step: a
+    ``(uint8-batch, mean, factor)`` tuple is normalized in-trace
+    (ops/fused_stem.decode_normalize — Pallas when the fused suite is
+    active, jnp otherwise) into the compute dtype; a plain array passes
+    through untouched. The tuple's mean/factor are traced ARGUMENTS,
+    not baked constants, so two iterators with different normalization
+    metadata share one compiled step."""
+    if not isinstance(data, tuple):
+        return data
+    x, mean, factor = data
+    from .ops.fused_stem import decode_normalize
+    return decode_normalize(x, mean, factor, net.compute_dtype,
+                            fused=net._fused_now())
+
+
 def _chain_scan(one, length):
     """Wrap a modal one-step body into a ``length``-step lax.scan chain
     (update_chain): the (params, opt_state, net_state, rng) carry threads
@@ -210,6 +226,20 @@ class Trainer:
         self._sp_label_cache = None
         self._rng_key = None
         self._norm_fn = None
+        self._fold_cache = None
+        # input_fold (doc/tasks.md "Input fold"): device_normalize
+        # batches enter the compiled train step as uint8 and the
+        # cast/mean/scale happens IN-TRACE (ops/fused_stem), killing the
+        # separate normalize dispatch's fp32 HBM round-trip of the whole
+        # batch (~310 MB/step at flagship shape). Exact math (f32
+        # compute, one cast to the compute dtype — where the layers'
+        # own astype puts the input anyway), so auto means ON; off is
+        # the escape hatch. std (GSPMD dp/tp) train path only: the
+        # sp/pp shard_map steps keep the eager normalize.
+        from .config import parse_fused_mode
+        self.input_fold = (
+            parse_fused_mode(gp("input_fold", "auto")) != "off"
+            and self._sp == 1 and self._pp == 1)
         # one-step deferred train-metric fetch: device->host reads of step
         # N's outputs happen after step N+1 is dispatched, so the transfer
         # overlaps compute instead of syncing every update (the reference
@@ -1343,6 +1373,10 @@ class Trainer:
 
         def one(params, opt_state, net_state, accum, data, label, mask,
                 extra, rng, sched):
+            # input_fold: a (uint8, mean, factor) data tuple normalizes
+            # here, in-trace (fixed-batch chains re-fold per scan step —
+            # that IS the fused read: u8 in, compute dtype out)
+            data = _fold_input(data, net)
             (loss, (new_state, nodes)), grads = fwd_bwd(
                 params, opt_state, net_state, data, label, mask, extra, rng)
             params, opt_state, accum = _apply_grads(
@@ -1374,6 +1408,12 @@ class Trainer:
 
             def step(params, opt_state, net_state, accum, cnt0, data,
                      label, mask, extra, rng, sched):
+                # fold the whole stacked chain once BEFORE the scan: the
+                # (k,B,...) uint8 tuple's mean/factor have no chain axis
+                # to scan over, and one k-sized fold keeps the per-step
+                # reads in the compute dtype
+                data = _fold_input(data, net)
+
                 def sbody(carry, xs):
                     p, o, s, a, c, r = carry
                     d, l, m, e, sc = xs
@@ -1395,6 +1435,8 @@ class Trainer:
             # schedule trajectory as k plain update() calls
             def step(params, opt_state, net_state, data, label, mask,
                      extra, rng, sched):
+                data = _fold_input(data, net)   # once, pre-scan (above)
+
                 def sbody(carry, xs):
                     p, o, s, r = carry
                     d, l, m, e, sc = xs
@@ -1450,7 +1492,8 @@ class Trainer:
             self._rng_key = jax.random.fold_in(self._base_key,
                                                self._step_count)
         staged = self.stage_batch(batch)
-        args = (self.params, self.opt_state, self.net_state, staged.data,
+        data = self._fold_args(staged) if mode == "std" else staged.data
+        args = (self.params, self.opt_state, self.net_state, data,
                 staged.label, mask) \
             + ((tuple(staged.extra_data),) if mode == "std" else ()) \
             + (self._rng_key, self._sched_scalars())
@@ -1534,7 +1577,14 @@ class Trainer:
                 np.stack([np.asarray(b.data) for b in batches]),
                 np.ndim(batches[0].data) - 1)
             check_norms()
-            data = self._device_normalize(data, batches[0])
+            if self._fold_capable(batches[0]):
+                # input_fold: the stacked uint8 chain enters the step
+                # raw; the multi-chain step folds it once before its
+                # scan (_make_train_step)
+                mean, factor = self._fold_consts(batches[0].norm)
+                data = (data, mean, factor)
+            else:
+                data = self._device_normalize(data, batches[0])
             label = put_rows(
                 np.stack([np.asarray(b.label) for b in batches]), 1)
             n_extra = len(batches[0].extra_data)
@@ -1702,14 +1752,22 @@ class Trainer:
                              extra_data=extra, norm=None)
         if self._sp > 1:
             data, label = self._shard_seq_batch(batch.data, batch.label)
+            data = self._device_normalize(data, batch)
+            fold = False
         else:
             data, label = self.mesh.shard_batch(batch.data, batch.label)
-        data = self._device_normalize(data, batch)
+            # input_fold: ship the uint8 payload as-is and keep the norm
+            # metadata — the normalize happens in-trace at dispatch
+            # (_fold_args); everything else normalizes eagerly here
+            fold = self._fold_capable(batch)
+            if not fold:
+                data = self._device_normalize(data, batch)
         extra = [self.mesh.shard_batch(e) for e in batch.extra_data]
         return DataBatch(data=data, label=label,
                          num_batch_padd=batch.num_batch_padd,
                          inst_index=batch.inst_index, extra_data=extra,
-                         norm=None, host_label=batch.label)
+                         norm=batch.norm if fold else None,
+                         host_label=batch.label)
 
     def prefetch_device(self, it, depth: int = 2, for_eval: bool = False):
         """Wrap a batch iterable so ``depth`` batches are staged on-device
@@ -1738,7 +1796,10 @@ class Trainer:
                                                self._step_count)
         accum_in = self.accum if self.update_period > 1 else {}
         staged = self.stage_batch(batch)
-        data, label = staged.data, staged.label
+        # _fold_args: plain staged array, or the input_fold tuple whose
+        # normalize happens inside the step (no-op for sp/pp staging,
+        # which normalized eagerly)
+        data, label = self._fold_args(staged), staged.label
         if self._pp > 1:
             (self.params, self.opt_state, self.net_state, accum, loss,
              nodes, self._rng_key) = step(
@@ -1782,6 +1843,41 @@ class Trainer:
         if self.eval_train:
             self._drain_pending_metric()
             self._pending_metric = (nodes, batch)
+
+    def _fold_capable(self, batch: DataBatch) -> bool:
+        """True when this batch's deferred normalization should ride
+        INTO the compiled step (input_fold) instead of running as a
+        separate eager normalize dispatch: uint8 payload with norm
+        metadata, on the std train path."""
+        if not self.input_fold or batch.norm is None:
+            return False
+        return getattr(batch.data, "dtype", None) == np.uint8
+
+    def _fold_consts(self, norm: dict):
+        """Device-side (mean, factor) for the folded step, cached by
+        value like ``_norm_fn`` — same precedence and op order as
+        ``_device_normalize``."""
+        mean = norm.get("mean")
+        div = float(norm.get("divideby", 1.0))
+        scale = float(norm.get("scale", 1.0))
+        key = (None if mean is None
+               else np.asarray(mean, np.float32).tobytes(), div, scale)
+        if self._fold_cache is None or self._fold_cache[0] != key:
+            mean_c = (jnp.asarray(np.asarray(mean, np.float32))
+                      if mean is not None else None)
+            self._fold_cache = (key, mean_c, jnp.float32(scale / div))
+        _, mean_c, factor = self._fold_cache
+        return mean_c, factor
+
+    def _fold_args(self, staged: DataBatch):
+        """The step's ``data`` argument: the staged array as-is, or the
+        ``(uint8, mean, factor)`` tuple the folded step normalizes
+        in-trace (_fold_input). jit retraces on the structure switch,
+        so folded and unfolded batches can share a Trainer."""
+        if not self._fold_capable(staged):
+            return staged.data
+        mean, factor = self._fold_consts(staged.norm)
+        return (staged.data, mean, factor)
 
     def _device_normalize(self, data, batch: DataBatch):
         """device_normalize pipelines ship uint8 batches (4x smaller H2D)
@@ -2078,6 +2174,12 @@ class Trainer:
                                  self._sched_scalars())
         else:
             data, label = self.mesh.shard_batch(batch.data, batch.label)
+            if self._fold_capable(batch):
+                # cost-analyze the FOLDED step (uint8 in, normalize
+                # in-trace) so the input_fold bytes saving is visible in
+                # hbm_bytes_per_step, not hidden outside the step
+                mean, factor = self._fold_consts(batch.norm)
+                data = (data, mean, factor)
             extra = tuple(self.mesh.shard_batch(e) for e in batch.extra_data)
             lowered = step.lower(self.params, self.opt_state, self.net_state,
                                  accum_in, data, label, mask, extra, rng,

@@ -1,0 +1,269 @@
+"""Second kernel wave: fused uint8 stem decode-normalize (input_fold),
+fused pooling, and stem channel padding — interpret-mode parity
+fwd+grad, trainer integration, and every escape hatch."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cxxnet_tpu.config import parse_config_string
+from cxxnet_tpu.io.data import DataBatch
+from cxxnet_tpu.layers import ApplyCtx
+from cxxnet_tpu.ops.fused_pool import fused_pool, pool_reference
+from cxxnet_tpu.ops.fused_stem import (decode_normalize,
+                                       decode_normalize_reference,
+                                       fused_decode_normalize)
+from cxxnet_tpu.trainer import Trainer
+
+RNG = np.random.RandomState(7)
+
+
+# -- fused_stem ---------------------------------------------------------------
+
+@pytest.mark.parametrize("mean_kind", ["none", "channel", "image"])
+@pytest.mark.parametrize("out_dtype", ["float32", "bfloat16"])
+def test_stem_kernel_parity(mean_kind, out_dtype):
+    x = jnp.asarray(RNG.randint(0, 256, (8, 8, 16, 3), np.uint8))
+    mean = {"none": None,
+            "channel": jnp.asarray([120.0, 110.0, 100.0], jnp.float32),
+            "image": jnp.asarray(
+                RNG.rand(8, 16, 3).astype(np.float32) * 255)}[mean_kind]
+    factor = jnp.float32(1.0 / 255.0)
+    ref = decode_normalize_reference(x, mean, factor, out_dtype)
+    y = fused_decode_normalize(x, mean, factor, out_dtype,
+                               interpret=True)
+    assert y is not None
+    assert y.dtype == jnp.dtype(out_dtype)
+    # kernel computes in f32 and casts once — identical to reference
+    np.testing.assert_array_equal(np.asarray(y, np.float32),
+                                  np.asarray(ref, np.float32))
+
+
+def test_stem_kernel_gates():
+    # non-uint8 input and non-lane-aligned columns fall back to None
+    f = jnp.ones((8, 8, 16, 3), jnp.float32)
+    assert fused_decode_normalize(f, None, 1.0, "float32") is None
+    odd = jnp.ones((8, 5, 5, 3), jnp.uint8)      # 75 cols: no 128 block
+    assert fused_decode_normalize(odd, None, 1.0, "float32") is None
+    # the dispatcher always returns a value (reference fallback)
+    y = decode_normalize(odd, None, jnp.float32(1.0), "float32",
+                         fused=True)
+    assert y.shape == odd.shape
+
+
+# -- fused_pool ---------------------------------------------------------------
+
+POOL_CASES = [
+    # (B, H, W, C, kh, kw, stride, reducer, scale_avg, pre_relu)
+    (8, 8, 8, 16, 2, 2, 2, "max", False, False),
+    (8, 8, 8, 16, 2, 2, 2, "sum", True, False),    # avg_pooling
+    (8, 8, 8, 16, 2, 2, 2, "sum", False, False),   # sum_pooling
+    (8, 8, 8, 16, 2, 2, 2, "max", False, True),    # relu_max_pooling
+    (8, 7, 7, 16, 7, 7, 1, "sum", True, False),    # global avg (IBN head)
+    (8, 4, 4, 16, 4, 4, 4, "max", False, False),   # global max, 16 cells
+]
+
+
+@pytest.mark.parametrize("case", POOL_CASES)
+def test_pool_parity_fwd_grad(case):
+    b, h, w, c, kh, kw, s, red, sa, pr = case
+    x = jnp.asarray(RNG.randn(b, h, w, c).astype(np.float32))
+
+    def fused(x):
+        y = fused_pool(x, kh, kw, s, (0, 0), (0, 0), red, sa, pr,
+                       interpret=True)
+        assert y is not None
+        return y
+
+    ref = lambda x: pool_reference(x, kh, kw, s, red, sa, pr)
+    y1, y2 = fused(x), ref(x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                               atol=1e-6)
+    ct = jnp.cos(jnp.arange(y1.size, dtype=jnp.float32)
+                 .reshape(y1.shape) * 0.1)
+    g1 = jax.grad(lambda x: (fused(x) * ct).sum())(x)
+    g2 = jax.grad(lambda x: (ref(x) * ct).sum())(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               atol=1e-6)
+
+
+def test_pool_max_tie_first_match():
+    """All-equal windows: the fused backward must route the cotangent
+    to the FIRST window cell, exactly like XLA's select-and-scatter."""
+    x = jnp.ones((8, 4, 4, 8), jnp.float32)
+    g1 = jax.grad(lambda x: fused_pool(
+        x, 2, 2, 2, (0, 0), (0, 0), "max", False, False,
+        interpret=True).sum())(x)
+    g2 = jax.grad(lambda x: pool_reference(
+        x, 2, 2, 2, "max", False, False).sum())(x)
+    np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
+
+
+def test_pool_prerelu_zero_gradient():
+    """relu's zero-at-zero gradient: an all-zero window must produce
+    zero dx on both paths (jax.nn.relu custom-jvp parity)."""
+    x = jnp.zeros((8, 4, 4, 8), jnp.float32)
+    g1 = jax.grad(lambda x: fused_pool(
+        x, 2, 2, 2, (0, 0), (0, 0), "max", False, True,
+        interpret=True).sum())(x)
+    g2 = jax.grad(lambda x: pool_reference(
+        x, 2, 2, 2, "max", False, True).sum())(x)
+    np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
+    assert not np.any(np.asarray(g1))
+
+
+def test_pool_geometry_gates():
+    x = jnp.ones((8, 8, 8, 16), jnp.float32)
+    # overlapping, padded, and large-window max all fall back
+    assert fused_pool(x, 3, 3, 2, (0, 0), (0, 0), "max", False,
+                      False) is None
+    assert fused_pool(x, 2, 2, 2, (1, 1), (0, 0), "max", False,
+                      False) is None
+    assert fused_pool(x, 8, 8, 1, (0, 0), (0, 0), "max", False,
+                      False) is None        # 64 cells > first-match cap
+    assert fused_pool(x, 8, 8, 1, (0, 0), (0, 0), "sum", True,
+                      False) is not None    # global avg: any size
+
+
+def test_pool_layer_integration():
+    """The pooling layer takes the fused path under ctx.fused and the
+    reference path otherwise — same values either way."""
+    from cxxnet_tpu.graph import LayerSpec
+    from cxxnet_tpu.layers import create_layer
+    spec = LayerSpec(type="max_pooling", name="mp", nindex_in=[0],
+                     nindex_out=[1],
+                     cfg=[("kernel_size", "2"), ("stride", "2")])
+    layer = create_layer(spec, [])
+    layer.infer_shapes([(16, 8, 8)])
+    x = jnp.asarray(RNG.randn(4, 8, 8, 16).astype(np.float32))
+    os.environ["CXXNET_FUSED_KERNELS"] = "1"
+    try:
+        y_f, _ = layer.apply({}, {}, [x], ApplyCtx(train=True,
+                                                   fused=True))
+    finally:
+        del os.environ["CXXNET_FUSED_KERNELS"]
+    y_r, _ = layer.apply({}, {}, [x], ApplyCtx(train=True, fused=False))
+    np.testing.assert_allclose(np.asarray(y_f), np.asarray(y_r),
+                               atol=1e-6)
+
+
+# -- trainer integration: input_fold + stem_pad -------------------------------
+
+CONF = """
+netconfig = start
+layer[0->1] = conv:cv1
+  kernel_size = 3
+  nchannel = 8
+  stride = 2
+  pad = 1
+layer[1->2] = batch_norm:bn1
+layer[2->3] = relu
+layer[3->4] = max_pooling:mp
+  kernel_size = 2
+  stride = 2
+layer[4->5] = flatten
+layer[5->6] = fullc:fc
+  nhidden = 5
+layer[6->6] = softmax
+netconfig = end
+input_shape = 3,16,16
+batch_size = 8
+eval_train = 0
+dev = cpu:0-0
+"""
+
+
+def _run(overrides, batch_fn, n=4):
+    tr = Trainer(parse_config_string(CONF) + list(overrides))
+    tr.init_model()
+    out = []
+    for _ in range(n):
+        tr.update(batch_fn())
+        out.append(tr.last_loss)
+    return out, tr
+
+
+U8 = RNG.randint(0, 256, (8, 16, 16, 3), np.uint8)
+LAB = RNG.randint(0, 5, (8, 1)).astype(np.float32)
+NORM = {"mean": np.asarray([120.0, 110.0, 100.0], np.float32),
+        "divideby": 255.0, "scale": 1.0}
+
+
+def _u8_batch():
+    return DataBatch(data=U8.copy(), label=LAB.copy(), norm=dict(NORM))
+
+
+def test_input_fold_bit_parity_and_hatch():
+    """Folded (in-step) normalization is bit-identical to the eager
+    _device_normalize path under the fp32 policy; input_fold=0 is the
+    escape hatch and must change nothing."""
+    l_fold, tr = _run((), _u8_batch)
+    l_eager, tr0 = _run((("input_fold", "0"),), _u8_batch)
+    assert tr.input_fold and not tr0.input_fold
+    np.testing.assert_array_equal(np.asarray(l_fold),
+                                  np.asarray(l_eager))
+
+
+def test_input_fold_chain_paths():
+    tr = Trainer(parse_config_string(CONF))
+    tr.init_model()
+    losses = tr.update_chain(_u8_batch(), 3)
+    assert np.all(np.isfinite(np.asarray(losses)))
+    losses2 = tr.update_chain_batches([_u8_batch(), _u8_batch()])
+    assert np.all(np.isfinite(np.asarray(losses2)))
+
+
+def test_input_fold_cost_analysis_smaller():
+    """The folded step's compiled cost analysis must charge fewer bytes
+    than the f32-input step: the uint8 input is 1/4 the read and the
+    fp32 normalize round-trip is gone."""
+    tr = Trainer(parse_config_string(CONF))
+    tr.init_model()
+    cost_fold = tr.step_cost_analysis(_u8_batch())
+    f32 = ((U8.astype(np.float32) - NORM["mean"]) / 255.0)
+    cost_f32 = tr.step_cost_analysis(
+        DataBatch(data=f32, label=LAB.copy()))
+    assert cost_fold["bytes_accessed"] < cost_f32["bytes_accessed"]
+
+
+def test_input_fold_fused_kernel_path():
+    os.environ["CXXNET_FUSED_KERNELS"] = "1"
+    try:
+        l_fused, _ = _run((), _u8_batch)
+    finally:
+        del os.environ["CXXNET_FUSED_KERNELS"]
+    l_ref, _ = _run((), _u8_batch)
+    np.testing.assert_allclose(np.asarray(l_fused), np.asarray(l_ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_input_fold_eval_unchanged():
+    """Eval/predict stages normalize eagerly — a fold-capable batch
+    predicts identically with the fold on and off."""
+    tr = Trainer(parse_config_string(CONF))
+    tr.init_model()
+    p1 = tr.predict_raw(_u8_batch())
+    tr0 = Trainer(parse_config_string(CONF) + [("input_fold", "0")])
+    tr0.init_model()
+    p0 = tr0.predict_raw(_u8_batch())
+    np.testing.assert_array_equal(p1, p0)
+
+
+def test_stem_pad_parity_and_hatch():
+    f32 = RNG.rand(8, 16, 16, 3).astype(np.float32)
+    mk = lambda: DataBatch(data=f32.copy(), label=LAB.copy())
+    l_pad, tr = _run((), mk)
+    l_off, tr0 = _run((("stem_pad", "0"),), mk)
+    assert tr.net._cin_pad == {0: 4} and tr0.net._cin_pad == {}
+    np.testing.assert_allclose(np.asarray(l_pad), np.asarray(l_off),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_stem_pad_checkpoint_shape_unchanged():
+    """Padding is apply-time only: params keep the canonical cin."""
+    tr = Trainer(parse_config_string(CONF))
+    tr.init_model()
+    assert tr.params["cv1"]["wmat"].shape == (3, 3, 3, 8)

@@ -1,0 +1,202 @@
+"""telemetry.traceparse: golden wire-format tests on a minimal
+checked-in trace (constructed byte-for-byte below), classification
+rules, and an end-to-end capture+parse on the CPU backend."""
+
+import gzip
+import json
+import os
+import struct
+import tempfile
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.telemetry import traceparse as tp
+
+
+# -- minimal protobuf ENCODER (test-side twin of the module's reader) ---------
+
+def _varint(v: int) -> bytes:
+    out = b""
+    while True:
+        b7 = v & 0x7F
+        v >>= 7
+        out += bytes([b7 | (0x80 if v else 0)])
+        if not v:
+            return out
+
+
+def _field(num: int, wt: int, payload: bytes) -> bytes:
+    return _varint((num << 3) | wt) + payload
+
+
+def _ld(num: int, payload: bytes) -> bytes:      # length-delimited
+    return _field(num, 2, _varint(len(payload)) + payload)
+
+
+def _meta_entry(mid: int, name: str) -> bytes:
+    """map<int64, XEventMetadata/XStatMetadata> entry."""
+    meta = _field(1, 0, _varint(mid)) + _ld(2, name.encode())
+    return _field(1, 0, _varint(mid)) + _ld(2, meta)
+
+
+def _stat(mid: int, *, double=None, uint=None, s=None) -> bytes:
+    out = _field(1, 0, _varint(mid))
+    if double is not None:
+        out += _field(2, 1, struct.pack("<d", double))
+    if uint is not None:
+        out += _field(3, 0, _varint(uint))
+    if s is not None:
+        out += _ld(5, s.encode())
+    return out
+
+
+def _event(mid: int, dur_ps: int, stats=()) -> bytes:
+    out = _field(1, 0, _varint(mid)) + _field(3, 0, _varint(dur_ps))
+    for st in stats:
+        out += _ld(4, st)
+    return out
+
+
+def golden_xplane() -> bytes:
+    """One device plane, one 'XLA Ops' line, three op events with
+    bytes-accessed stats — the minimal TPU-shaped trace."""
+    events = (
+        _event(1, 5_000_000, [_stat(10, uint=1000)]),       # conv, 5 us
+        _event(2, 2_000_000, [_stat(10, uint=200)]),        # bn fusion
+        _event(3, 1_000_000, [_stat(10, uint=50),
+                              _stat(11, s="convolution")]),  # category
+    )
+    line = _ld(2, b"XLA Ops") + b"".join(_ld(4, e) for e in events)
+    plane = (
+        _ld(2, b"/device:TPU:0")
+        + _ld(3, line)
+        + _ld(4, _meta_entry(1, "convolution.42"))
+        + _ld(4, _meta_entry(2, "fusion.7"))
+        + _ld(4, _meta_entry(3, "fusion.9"))
+        + _ld(5, _meta_entry(10, "bytes accessed"))
+        + _ld(5, _meta_entry(11, "hlo_category"))
+    )
+    return _ld(1, plane)
+
+
+def _write_dump(root: str, xplane: bytes = None, trace: dict = None):
+    d = os.path.join(root, "plugins", "profile", "2026_01_01_00_00_00")
+    os.makedirs(d, exist_ok=True)
+    if xplane is not None:
+        with open(os.path.join(d, "host.xplane.pb"), "wb") as f:
+            f.write(xplane)
+    if trace is not None:
+        with gzip.open(os.path.join(d, "host.trace.json.gz"), "wb") as f:
+            f.write(json.dumps(trace).encode())
+    return d
+
+
+def test_xplane_golden_structure():
+    with tempfile.TemporaryDirectory() as td:
+        _write_dump(td, xplane=golden_xplane())
+        files = tp.find_profile_files(td)
+        assert files["xplane"] and files["trace_json"] is None
+        planes = tp.parse_xplane(files["xplane"])
+    assert len(planes) == 1
+    p = planes[0]
+    assert p["name"] == "/device:TPU:0"
+    assert len(p["lines"]) == 1 and p["lines"][0]["name"] == "XLA Ops"
+    evs = {e.name: e for e in p["lines"][0]["events"]}
+    assert evs["convolution.42"].dur_ps == 5_000_000
+    assert evs["convolution.42"].stats["bytes accessed"] == 1000
+    assert evs["fusion.9"].category == "convolution"
+
+
+def test_xplane_golden_attribution():
+    with tempfile.TemporaryDirectory() as td:
+        _write_dump(td, xplane=golden_xplane())
+        att = tp.attribute_profile(td, steps=2)
+    # conv = convolution.42 (name) + fusion.9 (hlo_category override)
+    assert att["source"] == "xplane"
+    conv = att["phases"]["conv"]
+    assert conv["count"] == 2
+    assert abs(conv["ms"] - (5 + 1) / 1e3 / 2) < 1e-9   # per-step ms
+    assert "other" in att["phases"]                      # fusion.7
+    # bytes: (1000 + 200 + 50) / 2 steps
+    assert att["measured_bytes_per_step"] == 625.0
+    frag = tp.attribution_fragment(att)
+    assert "conv:" in frag and "hbm=" in frag
+
+
+def test_trace_json_golden():
+    doc = {"traceEvents": [
+        {"ph": "M", "pid": 7, "name": "process_name",
+         "args": {"name": "/host:CPU"}},
+        {"ph": "X", "pid": 7, "tid": 1, "ts": 0.0, "dur": 12.5,
+         "name": "convolution.3",
+         "args": {"hlo_module": "jit_step", "hlo_op": "convolution.3"}},
+        {"ph": "X", "pid": 7, "tid": 1, "ts": 20.0, "dur": 5.0,
+         "name": "while.9",       # container: must be excluded
+         "args": {"hlo_module": "jit_step", "hlo_op": "while.9"}},
+        {"ph": "X", "pid": 7, "tid": 2, "ts": 0.0, "dur": 2.0,
+         "name": "reduce-window.1",
+         "args": {"hlo_module": "jit_step",
+                  "hlo_op": "reduce-window.1"}},
+    ]}
+    with tempfile.TemporaryDirectory() as td:
+        _write_dump(td, trace=doc)
+        att = tp.attribute_profile(td, steps=1)
+    assert att["source"] == "trace_json"
+    assert att["phases"]["conv"]["ms"] == pytest.approx(0.0125)
+    assert att["phases"]["pool"]["ms"] == pytest.approx(0.002)
+    assert "other" not in att["phases"]      # the while container
+
+
+def test_no_dump_raises():
+    with tempfile.TemporaryDirectory() as td:
+        with pytest.raises(FileNotFoundError):
+            tp.attribute_profile(td)
+
+
+@pytest.mark.parametrize("name,cat,phase", [
+    ("convolution.12", "", "conv"),
+    ("conv_general_dilated", "", "conv"),
+    ("reduce-window.3", "", "pool"),
+    ("select-and-scatter.1", "", "pool"),
+    ("lrn_window_fusion", "", "lrn"),
+    ("dot.7", "", "matmul"),
+    ("copy.44", "", "h2d"),
+    ("infeed.1", "", "h2d"),
+    ("fused_optim_kernel", "", "optim"),
+    ("_bn_fwd_kernel", "", "bn_act"),
+    ("rsqrt_multiply_fusion", "", "bn_act"),
+    ("fusion.123", "", "other"),
+    ("fusion.9", "convolution fusion", "conv"),
+    ("fusion.10", "reduce window", "pool"),
+])
+def test_classify(name, cat, phase):
+    assert tp.classify_op(name, cat) == phase
+
+
+def test_end_to_end_cpu_capture():
+    """Real jax.profiler dump on the CPU backend parses and attributes
+    a conv-containing jit — the full capture->parse->classify loop the
+    bench and StepProfiler.summarize run."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(x, w):
+        y = jax.lax.conv_general_dilated(
+            x, w, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return jnp.tanh(y).sum()
+
+    x = jnp.ones((4, 16, 16, 8))
+    w = jnp.ones((3, 3, 8, 8))
+    step(x, w).block_until_ready()           # compile outside the trace
+    with tempfile.TemporaryDirectory() as td:
+        jax.profiler.start_trace(td)
+        for _ in range(2):
+            step(x, w).block_until_ready()
+        jax.profiler.stop_trace()
+        att = tp.attribute_profile(td, steps=2)
+    assert att["total_op_ms"] > 0
+    assert "conv" in att["phases"]
+    assert att["phases"]["conv"]["ms"] > 0

@@ -23,6 +23,16 @@ Layer mapping:
 Usage:
   python tools/import_caffe.py <net.conf> <model.caffemodel> <out.model>
       [--map src=dst ...] [--strict] [--no-rgb-flip]
+
+Mean-image import (``mean.binaryproto`` — the classic ImageNet
+preprocessing artifact):
+  python tools/import_caffe.py --mean mean.binaryproto mean.npy
+      [--no-rgb-flip]
+converts the Caffe BlobProto mean (NCHW, BGR) to this framework's
+(H, W, C) RGB ``.npy`` for the ``image_mean`` iterator knob. The
+iterators also load ``image_mean = <path>.binaryproto`` directly
+(io/augment.MeanStore), center-cropping a resize-sized mean to the
+input crop; this mode just materializes the .npy for inspection/reuse.
 """
 
 from __future__ import annotations
@@ -194,17 +204,38 @@ def caffe_to_keys(layers: List[Dict], rgb_flip: bool = True) -> Dict[str, np.nda
     return out
 
 
+def convert_mean(src: str, dst: str, rgb_flip: bool = True):
+    """mean.binaryproto -> (H, W, C) RGB float32 .npy."""
+    from cxxnet_tpu.io.augment import load_binaryproto_mean
+    with open(src, "rb") as f:
+        mean = load_binaryproto_mean(f.read(), rgb_flip=rgb_flip)
+    np.save(dst, mean)
+    return mean
+
+
 def main(argv=None):
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    from import_weights import import_weights
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--mean", action="store_true",
+                    help="convert a mean.binaryproto to .npy: "
+                         "--mean <src.binaryproto> <out.npy>")
     ap.add_argument("config")
     ap.add_argument("source")
-    ap.add_argument("output")
+    ap.add_argument("output", nargs="?")
     ap.add_argument("--map", action="append", default=[], metavar="SRC=DST")
     ap.add_argument("--strict", action="store_true")
     ap.add_argument("--no-rgb-flip", action="store_true")
     args = ap.parse_args(argv)
+    if args.mean:
+        # positionals shift: config=src, source=dst
+        mean = convert_mean(args.config, args.source,
+                            rgb_flip=not args.no_rgb_flip)
+        print(f"wrote {args.source}: mean image {mean.shape} "
+              f"(HWC RGB, range [{mean.min():.1f}, {mean.max():.1f}])")
+        return 0
+    if args.output is None:
+        ap.error("output model path required (or use --mean)")
+    from import_weights import import_weights
     rename = dict(m.split("=", 1) for m in args.map)
     import_weights(args.config, args.source, args.output, fmt="caffe",
                    rename=rename, strict=args.strict,
