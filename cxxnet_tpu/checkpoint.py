@@ -36,6 +36,7 @@ import numpy as np
 
 from .io import stream
 from .resilience import counters, failpoints
+from .telemetry.ledger import LEDGER
 from .telemetry.registry import REGISTRY
 from .telemetry.trace import TRACER
 
@@ -103,12 +104,14 @@ def save_model(path: str, *, structure_sig: tuple, round_counter: int,
                opt_state: Optional[Any] = None, step_count: int = 0,
                lr_scale: float = 1.0) -> None:
     t0 = time.perf_counter()
+    ok = False
     try:
         _save_model(path, structure_sig=structure_sig,
                     round_counter=round_counter,
                     epoch_counter=epoch_counter, params=params,
                     net_state=net_state, opt_state=opt_state,
                     step_count=step_count, lr_scale=lr_scale)
+        ok = True
     finally:
         # span + histogram recorded on the WRITING thread (covers the
         # save_async path too); failures still count their duration
@@ -116,6 +119,8 @@ def save_model(path: str, *, structure_sig: tuple, round_counter: int,
         _H_CKPT.labels("save").observe(t1 - t0)
         TRACER.add_complete("ckpt.save", t0, t1, cat="ckpt",
                             args={"round": round_counter})
+        LEDGER.event("ckpt_save", round=round_counter, path=path,
+                     seconds=round(t1 - t0, 4), ok=ok)
 
 
 def _save_model(path: str, *, structure_sig: tuple, round_counter: int,
@@ -160,13 +165,18 @@ def _load_groups(path: str, include_opt: bool, verify: bool = True):
     (format_version >= 2; older archives have no digests and only get
     the torn-archive structural checks)."""
     t0 = time.perf_counter()
+    ok = False
     try:
-        return _load_groups_inner(path, include_opt, verify)
+        out = _load_groups_inner(path, include_opt, verify)
+        ok = True
+        return out
     finally:
         t1 = time.perf_counter()
         _H_CKPT.labels("load").observe(t1 - t0)
         TRACER.add_complete("ckpt.load", t0, t1, cat="ckpt",
                             args={"path": os.path.basename(path)})
+        LEDGER.event("ckpt_load", path=path,
+                     seconds=round(t1 - t0, 4), ok=ok)
 
 
 def _load_groups_inner(path: str, include_opt: bool, verify: bool = True):

@@ -114,6 +114,18 @@ class TelemetryConfig:
     profile_steps: str = ""       # telemetry_profile_steps: "a-b"
     profile_dir: str = ""         # telemetry_profile_dir: xprof dump dir
     steptime: int = 1             # telemetry_steptime: 0 disables probe
+    # -- fleet observability (doc/tasks.md "Fleet observability") -----
+    ledger_path: str = ""         # telemetry_ledger: run-ledger JSONL
+    run_id: str = ""              # telemetry_run_id: share across procs
+    fleet_dir: str = ""           # telemetry_fleet_dir: snapshot push dir
+    push_interval_s: float = 10.0  # telemetry_push_interval (seconds)
+    host: int = -1                # telemetry_host: -1 = jax process index
+    hang_s: float = 0.0           # telemetry_hang_s: 0 = watchdog off
+    hang_dryrun: int = 0          # telemetry_hang_dryrun: 1 = one dump
+    straggler_factor: float = 2.0  # telemetry_straggler_factor
+    straggler_min_steps: int = 8  # telemetry_straggler_min_steps
+    storm_window_s: float = 60.0  # telemetry_storm_window (seconds)
+    storm_threshold: int = 8      # telemetry_storm_threshold
 
 
 def parse_telemetry_config(cfg: ConfigPairs) -> TelemetryConfig:
@@ -131,6 +143,17 @@ def parse_telemetry_config(cfg: ConfigPairs) -> TelemetryConfig:
         "telemetry_profile_steps": ("profile_steps", str),
         "telemetry_profile_dir": ("profile_dir", str),
         "telemetry_steptime": ("steptime", int),
+        "telemetry_ledger": ("ledger_path", str),
+        "telemetry_run_id": ("run_id", str),
+        "telemetry_fleet_dir": ("fleet_dir", str),
+        "telemetry_push_interval": ("push_interval_s", float),
+        "telemetry_host": ("host", int),
+        "telemetry_hang_s": ("hang_s", float),
+        "telemetry_hang_dryrun": ("hang_dryrun", int),
+        "telemetry_straggler_factor": ("straggler_factor", float),
+        "telemetry_straggler_min_steps": ("straggler_min_steps", int),
+        "telemetry_storm_window": ("storm_window_s", float),
+        "telemetry_storm_threshold": ("storm_threshold", int),
     }
     vals = {}
     for name, val in cfg:
@@ -160,6 +183,26 @@ def parse_telemetry_config(cfg: ConfigPairs) -> TelemetryConfig:
         raise ConfigError(
             f"telemetry_log_interval must be > 0, got "
             f"{tc.log_interval_s}")
+    if tc.push_interval_s <= 0:
+        raise ConfigError(
+            f"telemetry_push_interval must be > 0, got "
+            f"{tc.push_interval_s}")
+    if tc.hang_s < 0:
+        raise ConfigError(
+            f"telemetry_hang_s must be >= 0, got {tc.hang_s}")
+    if tc.straggler_factor <= 1.0:
+        raise ConfigError(
+            f"telemetry_straggler_factor must be > 1, got "
+            f"{tc.straggler_factor}")
+    if tc.straggler_min_steps < 1:
+        raise ConfigError(
+            f"telemetry_straggler_min_steps must be >= 1, got "
+            f"{tc.straggler_min_steps}")
+    if tc.storm_window_s <= 0 or tc.storm_threshold < 1:
+        raise ConfigError(
+            "telemetry_storm_window must be > 0 and "
+            "telemetry_storm_threshold >= 1, got "
+            f"{tc.storm_window_s}/{tc.storm_threshold}")
     if tc.profile_steps:
         from .telemetry.profiler import parse_step_range
         try:

@@ -21,6 +21,7 @@ import itertools
 import queue
 import threading
 import time
+import weakref
 from typing import List, Optional
 
 import numpy as np
@@ -38,11 +39,13 @@ class ThreadBufferIterator(DataIter):
     semaphore-handshake double buffer (thread_buffer.h:22-205); a queue of
     depth ``buffer_size`` generalizes it (depth 1 == double buffering).
 
-    Telemetry: queue depth rides a per-instance gauge
-    (``cxxnet_io_prefetch_queue_depth``) — the is-the-input-pipeline-
-    keeping-up signal the step-time probe's data-wait EMA corroborates —
-    and each upstream fetch lands in the
-    ``cxxnet_io_fetch_latency_seconds`` histogram."""
+    Telemetry: queue depth rides a per-instance COLLECT-CALLBACK gauge
+    (``cxxnet_io_prefetch_queue_depth``, GaugeChild.set_function): the
+    depth is read straight off the queue at snapshot/exposition time,
+    so a scrape or fleet push can never see a value staler than the
+    queue itself — the is-the-input-pipeline-keeping-up signal the
+    step-time probe's data-wait EMA corroborates. Each upstream fetch
+    lands in the ``cxxnet_io_fetch_latency_seconds`` histogram."""
 
     def set_param(self, name, val):
         if name == "buffer_size":
@@ -54,10 +57,23 @@ class ThreadBufferIterator(DataIter):
         self._queue: Optional[queue.Queue] = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
-        self._g_depth = REGISTRY.gauge(
+        g = REGISTRY.gauge(
             "cxxnet_io_prefetch_queue_depth",
-            "Batches buffered ahead by the threadbuffer iterator",
+            "Batches buffered ahead by the threadbuffer iterator "
+            "(evaluated at read time)",
             labels=("iter",)).labels(str(next(_TB_SEQ)))
+        # the callback reads through a weakref: _queue is rebound by
+        # before_first (so the LIVE queue is always the one measured),
+        # and a discarded iterator — there is no teardown hook — must
+        # not stay pinned in the process-global registry along with its
+        # queue of buffered batches
+        ref = weakref.ref(self)
+
+        def _depth() -> int:
+            s = ref()
+            q = s._queue if s is not None else None
+            return q.qsize() if q is not None else 0
+        g.set_function(_depth)
         self._h_fetch = REGISTRY.histogram(
             "cxxnet_io_fetch_latency_seconds",
             "Upstream batch-fetch latency inside the prefetch producer")
@@ -79,7 +95,6 @@ class ThreadBufferIterator(DataIter):
             while not self._stop.is_set():
                 try:
                     self._queue.put(batch, timeout=0.05)
-                    self._g_depth.set(self._queue.qsize())
                     break
                 except queue.Full:
                     continue
@@ -103,7 +118,6 @@ class ThreadBufferIterator(DataIter):
                 self._thread.join(timeout=0.05)
         self._stop.clear()
         self._queue = queue.Queue(maxsize=self.buffer_size)
-        self._g_depth.set(0)
         self._thread = threading.Thread(target=self._producer, daemon=True,
                                         name="io-threadbuffer")
         self._thread.start()
@@ -111,9 +125,7 @@ class ThreadBufferIterator(DataIter):
     def next(self):
         if self._queue is None:
             self.before_first()
-        batch = self._queue.get()
-        self._g_depth.set(self._queue.qsize())
-        return batch
+        return self._queue.get()
 
 
 @register_iter("membuffer")

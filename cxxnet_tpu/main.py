@@ -25,6 +25,7 @@ from .graph import global_param
 from .io.data import DataBatch, create_iterator
 from .resilience import SentinelAbort, TrainingSentinel, counters, failpoints
 from .telemetry import TelemetrySession
+from .telemetry.ledger import LEDGER, config_hash
 from .telemetry.trace import TRACER
 from .trainer import Trainer
 from . import checkpoint as ckpt
@@ -175,18 +176,76 @@ class LearnTask:
             # non-root ranks keep the step-time probe (it is local and
             # silent) but must not bind the scrape port or clobber the
             # root's trace/log files — root-only observability, same
-            # policy as progress logging
+            # policy as progress logging. The FLEET paths (snapshot
+            # push, ledger appends) stay on for every rank: they are
+            # per-host by design (host field / host_<k>.json).
             import dataclasses as _dc
             self.telemetry_cfg = _dc.replace(
                 self.telemetry_cfg, port=0, trace_path="", log_path="")
+        # fleet host identity: telemetry_host overrides (independent
+        # processes without jax.distributed, e.g. tools/smoke_fleet.py);
+        # default is the jax process index
+        self._tel_host = (self.telemetry_cfg.host
+                          if self.telemetry_cfg.host >= 0
+                          else jax.process_index())
+        # run identity must AGREE across ranks of one jax.distributed
+        # run: auto-generated ids are per-process (time+pid+random), so
+        # host 0's aggregator would reject every other rank's snapshots
+        # as previous-run leftovers and the shared ledger would carry N
+        # disjoint run_ids. With no explicit telemetry_run_id /
+        # CXXNET_RUN_ID, rank 0 generates and broadcasts.
+        if not (self.telemetry_cfg.run_id
+                or os.environ.get("CXXNET_RUN_ID")) \
+                and jax.process_count() > 1:
+            import dataclasses as _dc
+            from .telemetry.ledger import new_run_id
+            rid = new_run_id() if jax.process_index() == 0 else ""
+            try:
+                from jax.experimental import multihost_utils
+                buf = np.zeros(64, np.uint8)
+                b = rid.encode("ascii")[:64]
+                buf[:len(b)] = np.frombuffer(b, np.uint8)
+                out = np.asarray(
+                    multihost_utils.broadcast_one_to_all(buf))
+                rid = bytes(out).rstrip(b"\x00").decode("ascii")
+            except Exception:
+                # no collective available (e.g. CPU multiprocess on
+                # old jax): keep per-rank ids rather than failing —
+                # the fleet merge then degrades, observability must
+                # never kill the run
+                pass
+            if rid:
+                self.telemetry_cfg = _dc.replace(
+                    self.telemetry_cfg, run_id=rid)
         # the session enables the tracer and starts the JSONL logger /
         # standalone /metrics endpoint immediately; run() closes it
         # (trace dump + final log flush). Built in __init__, not run(),
         # so tools that drive task_* methods directly still get a live
         # session.
-        self.telemetry = TelemetrySession(self.telemetry_cfg,
-                                          silent=bool(self.silent))
+        self.telemetry = TelemetrySession(
+            self.telemetry_cfg, silent=bool(self.silent),
+            cfg_hash=config_hash(self.cfg), host=self._tel_host)
         self.trainer = Trainer(self.global_cfg)
+        # the hang watchdog's progress source upgrades to the trainer's
+        # own step counter — it advances even with the step-time probe
+        # disabled (telemetry_steptime=0), so the watchdog stays armed
+        if self.telemetry.watchdog is not None:
+            tr = self.trainer
+            self.telemetry.watchdog.progress_fn = \
+                lambda: tr._step_count
+        # run_start anchors the ledger: identity + config + the mesh
+        # this process actually brought up
+        from .parallel import mesh as mesh_mod
+        m = self.trainer.mesh
+        LEDGER.event(
+            "run_start", task=self.task,
+            config_hash=self.telemetry.cfg_hash,
+            process_count=jax.process_count(),
+            process_index=jax.process_index(),
+            devices=m.num_devices, platform=jax.devices()[0].platform,
+            mesh={"data": m.data_parallel, "seq": m.seq_parallel,
+                  "pipe": m.pipeline_parallel, "model": m.model_parallel},
+            dist=mesh_mod.LAST_DIST_INIT)
 
     # -- iterators ---------------------------------------------------------
     def _make_iter(self, pairs: ConfigPairs):
@@ -267,6 +326,7 @@ class LearnTask:
 
     # -- tasks -------------------------------------------------------------
     def run(self) -> None:
+        status = "ok"
         try:
             if self.task in ("train", "finetune"):
                 self.task_train()
@@ -282,9 +342,14 @@ class LearnTask:
                 self.task_serve()
             else:
                 raise ValueError(f"unknown task {self.task!r}")
+        except BaseException as e:
+            # the ledger's run_end must name the failure mode — an
+            # aborted run with status "ok" would lie to the report tool
+            status = f"error:{type(e).__name__}"
+            raise
         finally:
             self.telemetry.close(
-                ready=self.trainer.last_loss_handle)
+                ready=self.trainer.last_loss_handle, status=status)
 
     def task_train(self) -> None:
         tr = self.trainer
@@ -360,6 +425,7 @@ class LearnTask:
                 break
         if reason is None:
             return
+        LEDGER.event("sentinel_trip", round=r, reason=reason)
         # drain any in-flight async checkpoint write BEFORE scanning —
         # a failed one degrades (counted) exactly like a sync failure,
         # and the scan must not race a live writer. No tmp sweep here:
@@ -393,6 +459,9 @@ class LearnTask:
             * self.lr_backoff
         sentinel.reset_window()
         counters.inc("sentinel.rollbacks")
+        LEDGER.event("rollback", round=r, to_round=r0, path=path,
+                     reason=reason,
+                     lr_scale=float(tr.optimizer.lr_scale))
         if not self.silent:
             print(f"sentinel: {reason}; rolled back to round {r0} "
                   f"checkpoint ({path}), lr_scale="
@@ -587,6 +656,15 @@ class LearnTask:
             if probe is not None:
                 # step-time breakdown + input-/compute-bound verdict
                 line += probe.report_fragment()
+            # fleet housekeeping (snapshot push, round_end ledger event,
+            # recompile-storm feed) + per-host medians / straggler
+            # verdicts on the aggregating host
+            dt_round = max(time.time() - round_start, 1e-9)
+            line += self.telemetry.round_tick(
+                r, images=n_images, batches=batch_count,
+                seconds=round(dt_round, 3),
+                images_per_sec=round(n_images / dt_round, 2),
+                loss=tr.last_loss if batch_count else None)
             # the metric line always prints on the root rank, even under
             # silent=1 (reference emits it via TrackerPrint regardless)
             if self._is_root:
@@ -655,6 +733,14 @@ class LearnTask:
             breaker_reset_s=float(gp("serve_breaker_reset_s", "10")),
             degraded_queue_frac=float(gp("serve_degraded_queue_frac",
                                          "0.8")),
+            # latency SLO (doc/tasks.md "Fleet observability"):
+            # serve_slo_ms=0 disables tracking; burn rate over
+            # serve_slo_burn_degraded flips /healthz to degraded — the
+            # admission-control signal a balancer keys on
+            slo_ms=float(gp("serve_slo_ms", "0")),
+            slo_target=float(gp("serve_slo_target", "0.99")),
+            slo_window_s=float(gp("serve_slo_window_s", "60")),
+            slo_burn_degraded=float(gp("serve_slo_burn_degraded", "2")),
             silent=bool(self.silent))
         srv.start()
         srv.serve_until_interrupt()

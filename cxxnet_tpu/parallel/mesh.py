@@ -164,6 +164,7 @@ def maybe_distributed_init(cfg) -> bool:
     with a diagnostic instead of hanging forever — the analog of the
     reference tracker reporting bad ranks).
     """
+    global LAST_DIST_INIT
     coord = num = rank = None
     timeout = 300
     for k, v in cfg:
@@ -186,7 +187,16 @@ def maybe_distributed_init(cfg) -> bool:
             f"distributed init failed (coordinator={coord!r}, rank={rank}, "
             f"num_proc={num}, timeout={timeout}s): check dist_coordinator "
             "is reachable from every rank and all ranks were launched") from e
+    # recorded for the run ledger's run_start event (the telemetry
+    # session is built AFTER multi-host bring-up, so this is a note
+    # the ledger picks up rather than an event emitted here)
+    LAST_DIST_INIT = {"coordinator": coord, "num_proc": num, "rank": rank}
     return True
+
+
+# multi-host bring-up details of the last successful
+# jax.distributed.initialize in this process (None = single-process run)
+LAST_DIST_INIT = None
 
 
 def allreduce_metric_pairs(pairs):

@@ -15,9 +15,11 @@ Health semantics (what a load balancer keys routing on):
 * ``ok``       (200) — dispatching normally;
 * ``degraded`` (200) — still serving but impaired: the admitted-row
   queue is past ``degraded_queue_frac`` of its budget, the breaker is
-  half-open (probing a recovering device), or corrupt input records
-  have been skipped this process (``recordio.skipped``) — keep
-  routing, start paging;
+  half-open (probing a recovering device), corrupt input records
+  have been skipped this process (``recordio.skipped``), or the
+  latency-SLO burn rate is at/over ``slo_burn_degraded`` (the error
+  budget is being eaten unsustainably fast) — keep routing, start
+  paging;
 * ``open``     (503) — the circuit breaker is open: dispatches are
   failing and requests are being rejected fast — route elsewhere;
 * ``down``     (500) — the batcher worker is dead.
@@ -40,6 +42,8 @@ import numpy as np
 
 from ..resilience import CircuitBreaker, CircuitOpen, counters
 from ..telemetry import PROMETHEUS_CONTENT_TYPE, render_prometheus
+from ..telemetry.ledger import run_info
+from ..telemetry.slo import SLOTracker
 from ..telemetry.trace import TRACER
 from .batcher import Backpressure, DeadlineExceeded, MicroBatcher
 from .engine import InferenceEngine
@@ -156,7 +160,11 @@ class ServeServer:
                  result_timeout_s: float = 120.0,
                  breaker_threshold: int = 5,
                  breaker_reset_s: float = 10.0,
-                 degraded_queue_frac: float = 0.8):
+                 degraded_queue_frac: float = 0.8,
+                 slo_ms: float = 0.0,
+                 slo_target: float = 0.99,
+                 slo_window_s: float = 60.0,
+                 slo_burn_degraded: float = 2.0):
         self.engine = engine
         self.stats: ServingStats = engine.stats
         self.silent = silent
@@ -165,6 +173,18 @@ class ServeServer:
         self.result_timeout_s = result_timeout_s
         self.log_interval_s = log_interval_s
         self.degraded_queue_frac = float(degraded_queue_frac)
+        # latency SLO: every terminal outcome (ok/over-latency/reject/
+        # failure) is classified good/bad; the rolling burn rate feeds
+        # /healthz BELOW — degradation fires while the breaker is still
+        # closed, which is what makes it an admission-control signal
+        # rather than a post-mortem
+        self.slo_burn_degraded = float(slo_burn_degraded)
+        self.slo: Optional[SLOTracker] = None
+        if slo_ms > 0:
+            self.slo = SLOTracker(slo_ms, target=slo_target,
+                                  window_s=slo_window_s,
+                                  instance=self.stats.instance)
+            self.stats.slo = self.slo
         # breaker_threshold = 0 disables circuit breaking entirely
         self.breaker = (CircuitBreaker(failure_threshold=breaker_threshold,
                                        reset_timeout_s=breaker_reset_s)
@@ -200,17 +220,19 @@ class ServeServer:
         # probe needs — raw "open" would hold it out of rotation forever
         breaker_state = (self.breaker.effective_state()
                          if self.breaker is not None else "disabled")
+        burn = self.slo.burn_rate() if self.slo is not None else 0.0
         if not alive:
             status, code = "down", 500
         elif breaker_state == "open":
             status, code = "open", 503
         elif (breaker_state == "half_open"
               or queue_frac >= self.degraded_queue_frac
-              or skipped > 0):
+              or skipped > 0
+              or burn >= self.slo_burn_degraded):
             status, code = "degraded", 200
         else:
             status, code = "ok", 200
-        return code, {
+        out = {
             "status": status,
             "ok": status == "ok",           # back-compat boolean
             "breaker": breaker_state,
@@ -218,15 +240,23 @@ class ServeServer:
             "queue_frac": round(queue_frac, 4),
             "skipped_records": skipped,
         }
+        if self.slo is not None:
+            out["slo_burn_rate"] = round(burn, 4)
+        return code, out
 
     def statz(self) -> Dict:
         """ServingStats snapshot + the resilience state alongside it."""
         out = self.stats.snapshot()
         if self.breaker is not None:
             out["breaker"] = self.breaker.snapshot()
+        if self.slo is not None:
+            out["slo"] = self.slo.snapshot()
         out["queue"] = {"rows": self.batcher.queued_rows,
                         "max_rows": self.batcher.max_queue_rows}
         out["counters"] = counters.snapshot()
+        # run identity: joins this process's scraped/statz numbers with
+        # the run ledger and the training task's series (same run_id)
+        out["run"] = run_info()
         return out
 
     # -- lifecycle -------------------------------------------------------

@@ -101,6 +101,10 @@ class ServingStats:
             "cxxnet_serve_request_latency_seconds",
             "End-to-end request latency (submit -> result)",
             labels=("engine",)), *eng)
+        # optional latency-SLO tracker (telemetry.slo.SLOTracker),
+        # attached by ServeServer when serve_slo_ms is configured;
+        # every terminal outcome recorded here feeds it
+        self.slo = None
 
     # -- registry-backed attribute views ---------------------------------
     @property
@@ -174,6 +178,8 @@ class ServingStats:
         child references keep working; they just stop exporting."""
         for fam, vals in self._series:
             fam.remove_labels(*vals)
+        if self.slo is not None:
+            self.slo.unregister()
 
     # -- recording -------------------------------------------------------
     def record_request(self) -> None:
@@ -186,14 +192,20 @@ class ServingStats:
             self._c_rej_br.inc()
         else:
             self._c_rej_dl.inc()
+        if self.slo is not None:       # a rejected client missed the SLO
+            self.slo.record(ok=False)
 
     def record_failure(self) -> None:
         self._c_failed.inc()
+        if self.slo is not None:
+            self.slo.record(ok=False)
 
     def record_done(self, latency_s: float) -> None:
         now = time.time()
         self._c_ok.inc()
         self._h_lat.observe(latency_s)
+        if self.slo is not None:
+            self.slo.record(latency_s=latency_s, ok=True)
         with self._lock:
             self._lat.append(latency_s)
             self._done_ts.append(now)

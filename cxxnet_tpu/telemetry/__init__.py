@@ -24,6 +24,7 @@ driver (main.py) owns exactly one object with one ``close()``.
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 from .registry import REGISTRY, MetricRegistry, get_registry, log_buckets
@@ -32,6 +33,14 @@ from .steptime import StepTimeProbe
 from .exporter import (PROMETHEUS_CONTENT_TYPE, MetricsServer,
                        TelemetryLogger, render_prometheus)
 from .profiler import StepProfiler
+from .ledger import (LEDGER, RunLedger, config_hash, get_ledger,
+                     new_run_id, read_ledger, run_info, set_run_info)
+from .aggregate import (FleetAggregator, FleetView, SnapshotPusher,
+                        export_snapshot, merge_snapshots, quantile,
+                        render_fleet)
+from .anomaly import (HangWatchdog, RecompileStormDetector,
+                      StragglerDetector, install_compile_counter)
+from .slo import SLOTracker
 
 __all__ = [
     "REGISTRY", "MetricRegistry", "get_registry", "log_buckets",
@@ -39,6 +48,12 @@ __all__ = [
     "StepTimeProbe", "StepProfiler",
     "MetricsServer", "TelemetryLogger", "render_prometheus",
     "PROMETHEUS_CONTENT_TYPE", "TelemetrySession",
+    "LEDGER", "RunLedger", "get_ledger", "new_run_id", "config_hash",
+    "set_run_info", "run_info", "read_ledger",
+    "FleetAggregator", "FleetView", "SnapshotPusher", "export_snapshot",
+    "merge_snapshots", "quantile", "render_fleet",
+    "HangWatchdog", "RecompileStormDetector", "StragglerDetector",
+    "install_compile_counter", "SLOTracker",
 ]
 
 
@@ -49,12 +64,33 @@ class TelemetrySession:
     an unconfigured run pays only the disabled-tracer attribute checks.
     """
 
-    def __init__(self, cfg, silent: bool = False):
+    def __init__(self, cfg, silent: bool = False,
+                 cfg_hash: str = "", host: int = 0):
         self.cfg = cfg
         self.silent = silent
+        self.host = int(host)
         self.logger: Optional[TelemetryLogger] = None
         self.server: Optional[MetricsServer] = None
         self.profiler: Optional[StepProfiler] = None
+        self.pusher: Optional[SnapshotPusher] = None
+        self.aggregator: Optional[FleetAggregator] = None
+        self.straggler: Optional[StragglerDetector] = None
+        self.watchdog: Optional[HangWatchdog] = None
+        self.storm: Optional[RecompileStormDetector] = None
+        # run identity: explicit knob > env (so N processes of one run
+        # launched by a driver share one id) > fresh
+        self.run_id = (cfg.run_id or os.environ.get("CXXNET_RUN_ID")
+                       or new_run_id())
+        self.cfg_hash = cfg_hash
+        set_run_info(self.run_id, cfg_hash)
+        if cfg.ledger_path:
+            LEDGER.enable(cfg.ledger_path, self.run_id, host=self.host)
+        if cfg.ledger_path or cfg.fleet_dir:
+            # compile events feed the ledger + the storm detector
+            install_compile_counter()
+            self.storm = RecompileStormDetector(
+                window_s=cfg.storm_window_s,
+                threshold=cfg.storm_threshold)
         if cfg.trace_path:
             TRACER.enable(capacity=cfg.trace_capacity)
         if cfg.log_path:
@@ -77,15 +113,82 @@ class TelemetrySession:
         if cfg.profile_steps:
             self.profiler = StepProfiler(cfg.profile_steps,
                                          cfg.profile_dir)
+        if cfg.fleet_dir:
+            # every worker pushes; host 0 additionally aggregates and
+            # promotes its /metrics endpoint to the merged fleet view
+            self.pusher = SnapshotPusher(
+                cfg.fleet_dir, host=self.host,
+                interval_s=cfg.push_interval_s,
+                run_id=self.run_id).start()
+            if self.host == 0:
+                self.aggregator = FleetAggregator(cfg.fleet_dir,
+                                                  host=self.host,
+                                                  run_id=self.run_id)
+                self.straggler = StragglerDetector(
+                    factor=cfg.straggler_factor,
+                    min_steps=cfg.straggler_min_steps)
+                if self.server is not None:
+                    self.server.render_fn = self.aggregator.render
+        if cfg.hang_s > 0 or cfg.hang_dryrun:
+            # progress = the steptime probe's step counter (default-on);
+            # with telemetry_steptime=0 the watchdog never arms, which
+            # is documented behavior, not a hang
+            steps = REGISTRY.counter("cxxnet_steptime_steps_total")
+            self.watchdog = HangWatchdog(
+                cfg.hang_s if cfg.hang_s > 0 else 3600.0,
+                progress_fn=lambda: steps.value)
+            if cfg.hang_s > 0:
+                self.watchdog.start()
+            if cfg.hang_dryrun:
+                # exercise the capture -> ledger path end to end
+                # without counting a hang (tools/smoke_fleet.py)
+                self.watchdog.dump_now(dry_run=True)
 
     def make_probe(self) -> StepTimeProbe:
         return StepTimeProbe(sync_interval=self.cfg.sync_interval)
 
-    def close(self, ready=None) -> None:
+    def round_tick(self, round_no: int, **fields) -> str:
+        """End-of-round fleet housekeeping, called by the train loop:
+        push this worker's snapshot, feed the recompile-storm detector,
+        ledger the round boundary, and (aggregating host only) refresh
+        the fleet view for straggler verdicts. Returns a round-log
+        fragment ("" when there is nothing fleet-worthy to say)."""
+        LEDGER.event("round_end", round=round_no, **fields)
+        if self.pusher is not None:
+            self.pusher.push_now()
+        if self.storm is not None:
+            c = REGISTRY.get("cxxnet_compiles_total")
+            if c is not None:
+                self.storm.observe(c.value)
+        if self.aggregator is None or self.straggler is None:
+            return ""
+        view = self.aggregator.view()
+        verdicts = self.straggler.check(view, round_no)
+        frag = ""
+        if len(view.hosts) > 1:
+            meds = []
+            for h in view.hosts:
+                for vals, v in view.host_samples(
+                        "cxxnet_steptime_step_seconds", h):
+                    if isinstance(v, dict) and vals == () and v["count"]:
+                        meds.append("h%d=%.1f" % (h, 1e3 * quantile(
+                            v["buckets"], v["counts"], 0.5)))
+            if meds:
+                frag += "\tfleet_p50_ms:" + ",".join(meds)
+        frag += StragglerDetector.fragment(verdicts)
+        return frag
+
+    def close(self, ready=None, status: str = "ok") -> None:
         """Finalize in dependency order: close a live profiler bracket,
+        stop the watchdog, final fleet push, run_end to the ledger,
         flush the JSONL log, dump the trace, stop the scrape server."""
         if self.profiler is not None:
             self.profiler.close(ready)
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        if self.pusher is not None:
+            self.pusher.stop()
+        LEDGER.event("run_end", status=status)
         if self.logger is not None:
             self.logger.stop()
         if self.cfg.trace_path:

@@ -1,0 +1,275 @@
+"""Run ledger: append-only, schema-versioned JSONL event stream per run.
+
+The registry answers "what are the numbers right now"; the ledger
+answers "what HAPPENED to this run" — the durable, grep-able record a
+fleet operator reads after the fact: when did it start and on what
+mesh, which rounds completed, when did a checkpoint land, when did the
+sentinel trip and what did it roll back to, when did the serve breaker
+open, did a hang watchdog fire and what were the stacks. One line per
+event:
+
+    {"schema": 1, "ts": <unix>, "run_id": "...", "host": 0,
+     "event": "<type>", ...event fields}
+
+Design rules:
+
+* **append-only, atomic lines** — every write is one ``open(path,
+  "a")`` + single ``write()`` of one ``\\n``-terminated line. POSIX
+  O_APPEND makes sub-PIPE_BUF writes atomic, so several processes of a
+  multi-host run may share one ledger file on a shared filesystem;
+  the ``host`` field disambiguates. Oversized payloads (stack dumps)
+  are truncated to stay under the atomicity bound.
+* **schema-versioned, open-world reads** — every line carries
+  ``schema``; :func:`read_ledger` tolerates unknown event types and
+  unknown fields (they pass through untouched) and SKIPS malformed
+  lines instead of raising, so an old report tool reads a new ledger
+  and a torn tail write never poisons the whole history (golden test:
+  tests/test_fleet.py).
+* **never kill the run** — like every telemetry write path, IO errors
+  degrade to a counted drop (``cxxnet_ledger_drops_total``).
+
+Module-level :data:`LEDGER` follows the TRACER pattern: disabled by
+default (event() is one attr check), enabled by the task driver from
+``telemetry_ledger=<path>``. Run identity (run_id + config hash) lives
+here too — :func:`set_run_info` also registers the
+``cxxnet_run_info{run_id,config_hash}`` info-metric so scraped series
+from any process of the run are joinable with the ledger.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from .registry import REGISTRY
+
+LEDGER_SCHEMA = 1
+
+# one O_APPEND write() of at most this many bytes is atomic on every
+# POSIX filesystem that matters (PIPE_BUF floor is 512; Linux gives
+# 4096); stack dumps get truncated to fit
+_MAX_LINE_BYTES = 3584
+
+# the well-known event types this codebase emits (documented in
+# doc/tasks.md "Fleet observability"); readers MUST also accept types
+# not listed here — the schema is open-world by contract
+KNOWN_EVENTS = (
+    "run_start", "run_end", "round_end", "compile",
+    "ckpt_save", "ckpt_load", "rollback", "sentinel_trip",
+    "breaker_transition", "hang_dump", "straggler", "recompile_storm",
+)
+
+
+def _sanitize(v: Any) -> Any:
+    """NaN/Inf floats -> None before serialization: Python's json
+    would happily emit bare ``NaN`` tokens (a diverged run's loss is
+    exactly when the ledger gets read), which strict JSON consumers —
+    jq, JSON.parse, Go — reject. Same rule as aggregate.export_snapshot."""
+    if isinstance(v, float):
+        return v if math.isfinite(v) else None
+    if isinstance(v, dict):
+        return {k: _sanitize(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_sanitize(x) for x in v]
+    return v
+
+
+class RunLedger:
+    """One run's append-only event stream. Thread-safe; every event()
+    is open-write-close so concurrent processes interleave whole
+    lines, never bytes."""
+
+    def __init__(self, path: str, run_id: str, host: int = 0):
+        self.path = path
+        self.run_id = run_id
+        self.host = int(host)
+        self.events_written = 0
+        self._lock = threading.Lock()
+        self._c_drops = REGISTRY.counter(
+            "cxxnet_ledger_drops_total",
+            "Ledger events dropped on write errors")
+        d = os.path.dirname(os.path.abspath(path))
+        if d:
+            os.makedirs(d, exist_ok=True)
+
+    def event(self, etype: str, **fields: Any) -> None:
+        # envelope wins over caller fields: provenance (who wrote the
+        # line, when, for which run) must never be clobberable by an
+        # event payload that happens to use the same key
+        rec: Dict[str, Any] = dict(fields)
+        rec.update({
+            "schema": LEDGER_SCHEMA,
+            "ts": round(time.time(), 3),
+            "run_id": self.run_id,
+            "host": self.host,
+            "event": str(etype),
+        })
+        rec = _sanitize(rec)
+        try:
+            line = json.dumps(rec, sort_keys=True, default=str,
+                              allow_nan=False)
+        except Exception:
+            self._c_drops.inc()
+            return
+        # keep the envelope, shrink the big field(s): atomicity beats
+        # completeness for a crash-forensics stream. Iterative halving
+        # (re-serializing each time) because JSON escaping of newline-
+        # heavy payloads like stack dumps inflates the cut text — a
+        # single byte-count cut would tear the JSON mid-string.
+        tries = 0
+        while len(line.encode("utf-8")) + 1 > _MAX_LINE_BYTES \
+                and tries < 24:
+            tries += 1
+            k = max((k for k in rec
+                     if k not in ("schema", "ts", "run_id", "host",
+                                  "event") and isinstance(rec[k], str)),
+                    key=lambda k: len(rec[k]), default=None)
+            if k is None or len(rec[k]) <= 64:
+                # no big string left to shrink: drop extras wholesale
+                rec = {k2: rec[k2] for k2 in
+                       ("schema", "ts", "run_id", "host", "event")}
+                rec["truncated"] = True
+                line = json.dumps(rec, sort_keys=True, default=str)
+                break
+            rec[k] = rec[k][:max(64, len(rec[k]) // 2)] + "..."
+            line = json.dumps(rec, sort_keys=True, default=str)
+        with self._lock:
+            try:
+                with open(self.path, "a", encoding="utf-8") as f:
+                    f.write(line + "\n")
+                self.events_written += 1
+            except OSError:
+                self._c_drops.inc()
+
+
+class _DisabledLedger:
+    """No-op stand-in so call sites never need a None check; ``event``
+    is one method call that returns immediately."""
+    enabled = False
+    path = ""
+    run_id = ""
+    host = 0
+
+    def event(self, etype: str, **fields: Any) -> None:
+        pass
+
+
+class _LedgerProxy:
+    """The module global: forwards to the enabled RunLedger (or the
+    no-op). Enable/disable swap the target; held references through the
+    proxy always see the current state."""
+
+    def __init__(self):
+        self._target: Any = _DisabledLedger()
+
+    @property
+    def enabled(self) -> bool:
+        return isinstance(self._target, RunLedger)
+
+    @property
+    def path(self) -> str:
+        return self._target.path
+
+    @property
+    def run_id(self) -> str:
+        return getattr(self._target, "run_id", "") or RUN_INFO.get(
+            "run_id", "")
+
+    @property
+    def host(self) -> int:
+        return self._target.host
+
+    @property
+    def events_written(self) -> int:
+        return getattr(self._target, "events_written", 0)
+
+    def enable(self, path: str, run_id: str, host: int = 0) -> "RunLedger":
+        self._target = RunLedger(path, run_id, host=host)
+        return self._target
+
+    def disable(self) -> None:
+        self._target = _DisabledLedger()
+
+    def event(self, etype: str, **fields: Any) -> None:
+        self._target.event(etype, **fields)
+
+
+LEDGER = _LedgerProxy()
+
+
+def get_ledger() -> _LedgerProxy:
+    return LEDGER
+
+
+# -- run identity -------------------------------------------------------------
+
+RUN_INFO: Dict[str, str] = {}
+
+
+def new_run_id() -> str:
+    """Unique-enough run id: time + pid + 4 random hex. Readable in a
+    filename, grep-able in logs."""
+    import secrets
+    return "r%s-%05d-%s" % (time.strftime("%Y%m%d%H%M%S"),
+                            os.getpid() % 100000, secrets.token_hex(2))
+
+
+def config_hash(cfg_pairs) -> str:
+    """Order-sensitive sha256 over the (name, value) config pairs —
+    order matters in this config dialect (layer params attach to the
+    preceding layer line), so two configs that differ only in order ARE
+    different configs. 12 hex chars: enough to join, short enough for a
+    label value."""
+    import hashlib
+    h = hashlib.sha256()
+    for name, val in cfg_pairs:
+        h.update(("%s\x00%s\x01" % (name, val)).encode("utf-8"))
+    return h.hexdigest()[:12]
+
+
+def set_run_info(run_id: str, cfg_hash: str = "") -> None:
+    """Record run identity and export it as the standard info-metric
+    pattern: ``cxxnet_run_info{run_id="...",config_hash="..."} 1`` —
+    a constant-1 gauge whose labels make every scraped series from this
+    process joinable with the ledger (and with scrapes of the OTHER
+    processes/tasks of the same run)."""
+    RUN_INFO["run_id"] = run_id
+    RUN_INFO["config_hash"] = cfg_hash
+    REGISTRY.gauge("cxxnet_run_info",
+                   "Run identity (constant 1; labels join scrapes to "
+                   "the run ledger)",
+                   labels=("run_id", "config_hash")
+                   ).labels(run_id, cfg_hash).set(1)
+
+
+def run_info() -> Dict[str, str]:
+    """The /statz "run" section payload."""
+    return dict(RUN_INFO)
+
+
+# -- reading ------------------------------------------------------------------
+
+def iter_ledger(path: str) -> Iterator[Dict[str, Any]]:
+    """Yield parsed events; malformed lines (torn tail writes, stray
+    garbage) are SKIPPED, unknown event types and extra fields pass
+    through — open-world reads by contract."""
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(rec, dict) or "event" not in rec:
+                continue
+            yield rec
+
+
+def read_ledger(path: str) -> List[Dict[str, Any]]:
+    return list(iter_ledger(path))

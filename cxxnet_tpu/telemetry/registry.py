@@ -171,6 +171,14 @@ class HistogramChild(_Child):
         with self._lock:
             return self._sum
 
+    def raw(self) -> Tuple[Tuple[float, ...], List[int], float, int]:
+        """(bucket edges, per-bucket raw counts incl. the +Inf overflow
+        slot, sum, count) under ONE lock hold — the mergeable-snapshot
+        form (telemetry.aggregate): raw counts merge bucket-wise by
+        addition, which cumulative counts do not."""
+        with self._lock:
+            return self.buckets, list(self._counts), self._sum, self._count
+
     def cumulative(self) -> List[Tuple[float, int]]:
         """[(upper_bound, cumulative_count), ..., (inf, total)] — the
         exposition-format view."""

@@ -74,6 +74,12 @@ class StepTimeProbe:
                         "at sync points")
         self._g_wall = g("cxxnet_steptime_step_wall_seconds",
                          "EMA of per-step wall time")
+        # per-step wall-time DISTRIBUTION (not just the EMA): the fleet
+        # layer merges these bucket-wise across hosts and the straggler
+        # rule compares host median vs fleet median (telemetry.anomaly)
+        self._h_step = reg.histogram(
+            "cxxnet_steptime_step_seconds",
+            "Per-step wall time (window-averaged at each sync point)")
         self._c_sync = reg.counter(
             "cxxnet_steptime_syncs_total",
             "Blocking host-device syncs taken by the step-time probe")
@@ -130,6 +136,12 @@ class StepTimeProbe:
         if n <= 0:
             return
         wall = max(time.perf_counter() - (self._win_t0 or 0.0), 0.0)
+        # one histogram observation PER STEP at the window's average —
+        # step counts stay comparable across hosts with different sync
+        # intervals, which the fleet median comparison depends on
+        per_step = wall / n
+        for _ in range(n):
+            self._h_step.observe(per_step)
         a = self.ema_alpha
         mix = lambda old, new: new if old is None else old + a * (new - old)
         self.data_wait_ema = mix(self.data_wait_ema,

@@ -1,0 +1,357 @@
+#!/usr/bin/env python
+"""Run-report generator: ledger + telemetry JSONL + bench trajectory -> md.
+
+Gives training/serving runs the same artifact discipline the bench has:
+one markdown file a human (or the next session) reads to answer "what
+happened to this run" without grepping logs —
+
+  * identity & topology (run_start), outcome (run_end status);
+  * round trajectory (round_end events: images/sec, loss, seconds);
+  * incident timeline: sentinel trips, rollbacks, breaker transitions,
+    hang dumps (stack excerpt), stragglers, recompile storms;
+  * checkpoint activity (saves/loads, failures, IO seconds);
+  * step-time + fleet metrics from the LAST telemetry_log snapshot
+    (EMAs, per-host straggler ratios, hang/compile counters);
+  * serve SLO attainment & burn rate when the run served traffic;
+  * the BENCH_r*.json trajectory, so run context and perf history land
+    in one place.
+
+Ledger reads are open-world (telemetry.ledger.iter_ledger): unknown
+event types render in the timeline as-is, malformed lines are skipped.
+
+Usage:
+  python tools/report.py --ledger run.ledger.jsonl \
+      [--telemetry-log tel.jsonl] [--bench 'BENCH_r*.json' ...] \
+      [-o REPORT.md]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+from collections import Counter
+from typing import Any, Dict, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _ts(t: Optional[float]) -> str:
+    if not t:
+        return "?"
+    return time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime(t)) + "Z"
+
+
+def _fmt(v: Any) -> str:
+    if isinstance(v, float):
+        return "%.4g" % v
+    return str(v)
+
+
+def load_ledger(path: str) -> List[Dict[str, Any]]:
+    from cxxnet_tpu.telemetry.ledger import iter_ledger
+    return list(iter_ledger(path))
+
+
+def load_last_snapshot(path: str) -> Optional[Dict[str, Any]]:
+    """Last parseable line of a telemetry_log JSONL (+ its .1 rotation
+    predecessor is irrelevant — the newest line wins)."""
+    last = None
+    try:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and "metrics" in rec:
+                    last = rec
+    except OSError:
+        return None
+    return last
+
+
+# -- sections -----------------------------------------------------------------
+
+def section_identity(events: List[Dict], out: List[str]) -> None:
+    starts = [e for e in events if e.get("event") == "run_start"]
+    ends = [e for e in events if e.get("event") == "run_end"]
+    run_id = (starts or events or [{}])[0].get("run_id", "?")
+    out.append("# Run report — `%s`" % run_id)
+    out.append("")
+    if starts:
+        s = starts[0]
+        mesh = s.get("mesh") or {}
+        out.append("| field | value |")
+        out.append("|---|---|")
+        out.append("| started | %s |" % _ts(s.get("ts")))
+        out.append("| task | %s |" % s.get("task", "?"))
+        out.append("| config hash | `%s` |" % s.get("config_hash", "?"))
+        out.append("| platform | %s |" % s.get("platform", "?"))
+        out.append("| processes | %s |" % s.get("process_count", "?"))
+        out.append("| devices/process | %s |" % s.get("devices", "?"))
+        if mesh:
+            out.append("| mesh (data/seq/pipe/model) | %s/%s/%s/%s |" % (
+                mesh.get("data", 1), mesh.get("seq", 1),
+                mesh.get("pipe", 1), mesh.get("model", 1)))
+        hosts = sorted({e.get("host", 0) for e in events})
+        out.append("| hosts seen in ledger | %s |" %
+                   ",".join(str(h) for h in hosts))
+    if ends:
+        e = ends[-1]
+        out.append("| ended | %s (status: **%s**) |"
+                   % (_ts(e.get("ts")), e.get("status", "?")))
+    elif starts:
+        out.append("| ended | *no run_end event — crashed or still "
+                   "running* |")
+    out.append("")
+
+
+def section_rounds(events: List[Dict], out: List[str]) -> None:
+    rounds = [e for e in events if e.get("event") == "round_end"
+              and e.get("host", 0) == 0]
+    if not rounds:
+        return
+    out.append("## Round trajectory (host 0)")
+    out.append("")
+    out.append("| round | images | images/sec | seconds | loss |")
+    out.append("|---|---|---|---|---|")
+    shown = rounds if len(rounds) <= 30 else \
+        rounds[:10] + [None] + rounds[-19:]
+    for e in shown:
+        if e is None:
+            out.append("| ... | | | | |")
+            continue
+        out.append("| %s | %s | %s | %s | %s |" % (
+            e.get("round", "?"), e.get("images", ""),
+            _fmt(e.get("images_per_sec", "")), _fmt(e.get("seconds", "")),
+            _fmt(e.get("loss", ""))))
+    out.append("")
+
+
+_INCIDENT_EVENTS = ("sentinel_trip", "rollback", "breaker_transition",
+                    "hang_dump", "straggler", "recompile_storm")
+
+
+def section_incidents(events: List[Dict], out: List[str]) -> None:
+    counts = Counter(e.get("event") for e in events)
+    out.append("## Event summary")
+    out.append("")
+    out.append("| event | count |")
+    out.append("|---|---|")
+    for name, n in sorted(counts.items()):
+        out.append("| %s | %d |" % (name, n))
+    out.append("")
+    incidents = [e for e in events if e.get("event") not in
+                 ("round_end", "compile", "ckpt_save", "ckpt_load",
+                  "run_start", "run_end")]
+    if not incidents:
+        out.append("No incidents recorded — clean run.")
+        out.append("")
+        return
+    out.append("## Incident timeline")
+    out.append("")
+    for e in incidents[:100]:
+        etype = e.get("event")
+        host = e.get("host", 0)
+        line = "- %s `h%s` **%s**" % (_ts(e.get("ts")), host, etype)
+        if etype == "sentinel_trip":
+            line += ": %s" % e.get("reason", "?")
+        elif etype == "rollback":
+            line += ": round %s -> %s (lr_scale %s)" % (
+                e.get("round", "?"), e.get("to_round", "?"),
+                _fmt(e.get("lr_scale", "?")))
+        elif etype == "breaker_transition":
+            line += ": %s -> %s" % (e.get("from_state", "?"),
+                                    e.get("to_state", "?"))
+        elif etype == "straggler":
+            line += ": host %s at %sx fleet median (%ss vs %ss)" % (
+                e.get("straggler_host", e.get("host")),
+                e.get("ratio", "?"),
+                _fmt(e.get("median_s", "?")),
+                _fmt(e.get("fleet_median_s", "?")))
+        elif etype == "recompile_storm":
+            line += ": %s compiles in %ss window" % (
+                e.get("compiles_in_window", "?"), e.get("window_s", "?"))
+        elif etype == "hang_dump":
+            line += ": stalled %ss%s" % (
+                e.get("stalled_for_s", "?"),
+                " (dry run)" if e.get("dry_run") else "")
+        else:
+            extra = {k: v for k, v in e.items()
+                     if k not in ("schema", "ts", "run_id", "host",
+                                  "event")}
+            if extra:
+                line += ": " + _fmt(extra)
+        out.append(line)
+        if etype == "hang_dump" and e.get("stacks"):
+            first = str(e["stacks"]).strip().splitlines()
+            out.append("")
+            out.append("  ```")
+            out.extend("  " + l for l in first[:12])
+            if len(first) > 12:
+                out.append("  ... (%d more lines in ledger)"
+                           % (len(first) - 12))
+            out.append("  ```")
+    out.append("")
+
+
+def section_checkpoints(events: List[Dict], out: List[str]) -> None:
+    saves = [e for e in events if e.get("event") == "ckpt_save"]
+    loads = [e for e in events if e.get("event") == "ckpt_load"]
+    if not saves and not loads:
+        return
+    out.append("## Checkpoints")
+    out.append("")
+    for name, evs in (("saves", saves), ("loads", loads)):
+        if not evs:
+            continue
+        bad = [e for e in evs if not e.get("ok", True)]
+        secs = sum(float(e.get("seconds", 0) or 0) for e in evs)
+        out.append("- %d %s (%d failed), %.2fs total IO"
+                   % (len(evs), name, len(bad), secs))
+    out.append("")
+
+
+def section_telemetry(snap: Optional[Dict], out: List[str]) -> None:
+    if not snap:
+        return
+    m = snap["metrics"]
+    out.append("## Final telemetry snapshot")
+    out.append("")
+    out.append("(telemetry_log, uptime %ss)" % snap.get("uptime_s", "?"))
+    out.append("")
+    rows = []
+    for key, label, scale in (
+            ("cxxnet_steptime_step_wall_seconds", "step wall EMA (ms)", 1e3),
+            ("cxxnet_steptime_data_wait_seconds", "data wait EMA (ms)", 1e3),
+            ("cxxnet_steptime_device_block_seconds",
+             "device block EMA (ms)", 1e3),
+            ("cxxnet_steptime_steps_total", "steps", 1),
+            ("cxxnet_compiles_total", "compiles", 1),
+            ("cxxnet_hangs_total", "hangs detected", 1),
+            ("cxxnet_recompile_storms_total", "recompile storms", 1),
+            ("cxxnet_ledger_drops_total", "ledger drops", 1)):
+        v = m.get(key)
+        if v is not None:
+            rows.append("| %s | %s |" % (label, _fmt(v * scale)))
+    strag = {k: v for k, v in m.items()
+             if k.startswith("cxxnet_straggler_ratio")}
+    for k, v in sorted(strag.items()):
+        rows.append("| straggler ratio %s | %s |"
+                    % (k.split("{", 1)[-1].rstrip("}"), _fmt(v)))
+    if rows:
+        out.append("| metric | value |")
+        out.append("|---|---|")
+        out.extend(rows)
+    out.append("")
+    # serve SLO attainment, when the snapshot saw serve traffic
+    good = sum(v for k, v in m.items()
+               if k.startswith("cxxnet_serve_slo_requests_total")
+               and 'result="good"' in k)
+    bad = sum(v for k, v in m.items()
+              if k.startswith("cxxnet_serve_slo_requests_total")
+              and 'result="bad"' in k)
+    if good or bad:
+        total = good + bad
+        out.append("## Serve SLO")
+        out.append("")
+        out.append("| field | value |")
+        out.append("|---|---|")
+        out.append("| good / total | %d / %d |" % (good, total))
+        out.append("| attainment | %.4f |" % (good / total))
+        burns = {k: v for k, v in m.items()
+                 if k.startswith("cxxnet_serve_slo_burn_rate")}
+        for k, v in sorted(burns.items()):
+            out.append("| burn rate %s | %s |"
+                       % (k.split("{", 1)[-1].rstrip("}"), _fmt(v)))
+        out.append("")
+
+
+def section_bench(paths: List[str], out: List[str]) -> None:
+    """BENCH_r*.json trajectory. Two shapes are accepted: the driver's
+    wrapper (``{"n", "rc", "parsed": {...}|null}`` — r05's
+    ``parsed: null`` renders as a failed round, which is itself signal)
+    and a bare bench emit."""
+    entries = []
+    for p in paths:
+        try:
+            with open(p, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if "parsed" in doc or "rc" in doc:         # driver wrapper
+            entries.append((os.path.basename(p), doc.get("rc"),
+                            doc.get("parsed")))
+        else:
+            entries.append((os.path.basename(p), 0, doc))
+    if not entries:
+        return
+    out.append("## Bench trajectory")
+    out.append("")
+    out.append("| artifact | value | unit | mfu % | roofline % | note |")
+    out.append("|---|---|---|---|---|---|")
+    for name, rc, parsed in sorted(entries):
+        if not parsed:
+            out.append("| %s | — | | | | rc=%s, parsed=null |"
+                       % (name, rc))
+            continue
+        out.append("| %s | %s | %s | %s | %s | %s |" % (
+            name, _fmt(parsed.get("value", "")), parsed.get("unit", ""),
+            _fmt(parsed.get("mfu_pct", "")),
+            _fmt(parsed.get("roofline_pct", "")),
+            "truncated" if parsed.get("truncated_phases") else ""))
+    out.append("")
+
+
+def generate(ledger_path: str, telemetry_log: Optional[str],
+             bench_paths: List[str]) -> str:
+    events = load_ledger(ledger_path) if ledger_path else []
+    snap = load_last_snapshot(telemetry_log) if telemetry_log else None
+    out: List[str] = []
+    section_identity(events, out)
+    section_rounds(events, out)
+    section_incidents(events, out)
+    section_checkpoints(events, out)
+    section_telemetry(snap, out)
+    section_bench(bench_paths, out)
+    out.append("---")
+    out.append("*generated by tools/report.py from `%s`*"
+               % (ledger_path or "<no ledger>"))
+    return "\n".join(out) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--ledger", required=True,
+                    help="run-ledger JSONL (telemetry_ledger=...)")
+    ap.add_argument("--telemetry-log", default="",
+                    help="telemetry_log JSONL (last snapshot is used)")
+    ap.add_argument("--bench", nargs="*", default=[],
+                    help="BENCH_r*.json paths or globs")
+    ap.add_argument("-o", "--out", default="",
+                    help="output path (default: stdout)")
+    args = ap.parse_args(argv)
+    bench: List[str] = []
+    for pat in args.bench:
+        hits = sorted(glob.glob(pat))
+        bench.extend(hits if hits else [pat])
+    md = generate(args.ledger, args.telemetry_log or None, bench)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(md)
+        print("report -> %s" % args.out)
+    else:
+        sys.stdout.write(md)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
