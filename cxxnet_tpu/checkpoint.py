@@ -249,6 +249,21 @@ def _blob_from_groups(meta, groups) -> Dict[str, Any]:
     return blob
 
 
+def blob_digest(meta: Dict[str, Any]) -> str:
+    """Short (12-hex) content identity for a checkpoint: sha256 over its
+    sorted per-array digest map. Two checkpoints with identical bytes
+    share it; any changed array changes it. Used by the serve hot-reload
+    path to stamp ``weights_reload`` ledger events and replica versions
+    ('' for pre-v2 archives without digests)."""
+    digests = meta.get("digests")
+    if not digests:
+        return ""
+    h = hashlib.sha256()
+    for k in sorted(digests):
+        h.update(f"{k}={digests[k]};".encode("ascii"))
+    return h.hexdigest()[:12]
+
+
 def verify_model(path: str) -> Dict[str, Any]:
     """Full integrity pass (every group, digests included); returns the
     meta dict, raises :class:`CheckpointCorrupt` / OSError otherwise."""

@@ -214,6 +214,122 @@ def parse_telemetry_config(cfg: ConfigPairs) -> TelemetryConfig:
     return tc
 
 
+# -- serving ------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """The ``serve_*`` knob set (doc/tasks.md "Serving" / "Serving at
+    fleet scale"). One validated namespace, same contract as
+    ``telemetry_*``: a typo'd key raises instead of silently serving
+    with defaults."""
+    port: int = 8080              # serve_port
+    host: str = "127.0.0.1"       # serve_host
+    buckets: str = ""             # serve_buckets: comma ladder ('' = auto)
+    max_batch: int = 64           # serve_max_batch
+    cache_size: int = 16          # serve_cache_size
+    dtype: str = ""               # serve_dtype: compute-dtype override
+    max_latency_ms: float = 5.0   # serve_max_latency_ms
+    queue_rows: int = 1024        # serve_queue_rows
+    timeout_ms: float = 0.0       # serve_timeout_ms (0 = none)
+    log_interval_s: float = 30.0  # serve_log_interval
+    breaker_threshold: int = 5    # serve_breaker_threshold (0 = off)
+    breaker_reset_s: float = 10.0  # serve_breaker_reset_s
+    degraded_queue_frac: float = 0.8  # serve_degraded_queue_frac
+    slo_ms: float = 0.0           # serve_slo_ms (0 = SLO tracking off)
+    slo_target: float = 0.99      # serve_slo_target
+    slo_window_s: float = 60.0    # serve_slo_window_s
+    slo_burn_degraded: float = 2.0  # serve_slo_burn_degraded
+    # -- fleet (doc/tasks.md "Serving at fleet scale") -----------------
+    replicas: int = 1             # serve_replicas: engines in the pool
+    reload_s: float = 0.0         # serve_reload_s: ckpt poll (0 = off)
+    ab: int = 0                   # serve_ab: 1 = reloads hit canaries only
+    ab_replicas: int = 1          # serve_ab_replicas: canary subset size
+    admission: int = 1            # serve_admission: 0 disables shedding
+    drain_timeout_s: float = 30.0  # serve_drain_timeout_s: reload drain
+
+    @property
+    def fleet(self) -> bool:
+        """Whether task_serve builds a replica pool (any fleet feature
+        requested) instead of the plain single-engine path."""
+        return self.replicas > 1 or self.reload_s > 0 or self.ab > 0
+
+
+def parse_serve_config(cfg: ConfigPairs) -> ServeConfig:
+    """Collect/validate the ``serve_*`` keys (last occurrence wins;
+    unknown keys in the namespace fail fast)."""
+    known = {
+        "serve_port": ("port", int),
+        "serve_host": ("host", str),
+        "serve_buckets": ("buckets", str),
+        "serve_max_batch": ("max_batch", int),
+        "serve_cache_size": ("cache_size", int),
+        "serve_dtype": ("dtype", str),
+        "serve_max_latency_ms": ("max_latency_ms", float),
+        "serve_queue_rows": ("queue_rows", int),
+        "serve_timeout_ms": ("timeout_ms", float),
+        "serve_log_interval": ("log_interval_s", float),
+        "serve_breaker_threshold": ("breaker_threshold", int),
+        "serve_breaker_reset_s": ("breaker_reset_s", float),
+        "serve_degraded_queue_frac": ("degraded_queue_frac", float),
+        "serve_slo_ms": ("slo_ms", float),
+        "serve_slo_target": ("slo_target", float),
+        "serve_slo_window_s": ("slo_window_s", float),
+        "serve_slo_burn_degraded": ("slo_burn_degraded", float),
+        "serve_replicas": ("replicas", int),
+        "serve_reload_s": ("reload_s", float),
+        "serve_ab": ("ab", int),
+        "serve_ab_replicas": ("ab_replicas", int),
+        "serve_admission": ("admission", int),
+        "serve_drain_timeout_s": ("drain_timeout_s", float),
+    }
+    vals = {}
+    for name, val in cfg:
+        if name.startswith("serve_"):
+            if name not in known:
+                raise ConfigError(
+                    f"unknown serve setting {name!r}; valid keys: "
+                    + ", ".join(sorted(known)))
+            field, conv = known[name]
+            try:
+                vals[field] = conv(val)
+            except ValueError as e:
+                raise ConfigError(f"bad {name} value {val!r}: {e}")
+    sc = ServeConfig(**vals)
+    if sc.replicas < 1:
+        raise ConfigError(
+            f"serve_replicas must be >= 1, got {sc.replicas}")
+    if sc.max_batch < 1 or sc.queue_rows < 1 or sc.cache_size < 1:
+        raise ConfigError(
+            "serve_max_batch, serve_queue_rows and serve_cache_size "
+            f"must be >= 1, got {sc.max_batch}/{sc.queue_rows}/"
+            f"{sc.cache_size}")
+    if sc.breaker_threshold < 0:
+        raise ConfigError(
+            f"serve_breaker_threshold must be >= 0, got "
+            f"{sc.breaker_threshold}")
+    if sc.reload_s < 0:
+        raise ConfigError(
+            f"serve_reload_s must be >= 0, got {sc.reload_s}")
+    if sc.ab not in (0, 1):
+        raise ConfigError(f"serve_ab must be 0 or 1, got {sc.ab}")
+    if sc.ab_replicas < 1:
+        raise ConfigError(
+            f"serve_ab_replicas must be >= 1, got {sc.ab_replicas}")
+    if sc.ab and sc.ab_replicas >= sc.replicas:
+        raise ConfigError(
+            f"serve_ab_replicas ({sc.ab_replicas}) must be < "
+            f"serve_replicas ({sc.replicas}): A/B needs at least one "
+            "replica left on the old version")
+    if sc.slo_ms > 0 and not 0.0 < sc.slo_target < 1.0:
+        raise ConfigError(
+            f"serve_slo_target must be in (0, 1), got {sc.slo_target}")
+    if sc.drain_timeout_s < 0:
+        raise ConfigError(
+            f"serve_drain_timeout_s must be >= 0, got "
+            f"{sc.drain_timeout_s}")
+    return sc
+
+
 # -- IO retry policy ----------------------------------------------------------
 
 @dataclasses.dataclass(frozen=True)

@@ -681,67 +681,102 @@ class LearnTask:
 
     def task_serve(self) -> None:
         """Online inference endpoint (serve/): the request-driven analog
-        of the offline pred/pred_raw/extract task modes. Blocks until
-        SIGINT/SIGTERM, then drains the batcher before exiting."""
-        from .serve import InferenceEngine
-        from .serve.engine import restore_inference_state
+        of the offline pred/pred_raw/extract task modes. Single engine
+        by default; any fleet knob (serve_replicas > 1, serve_reload_s,
+        serve_ab) builds a replica pool with SLO-aware routing and the
+        checkpoint hot-reload watcher. Blocks until SIGINT/SIGTERM,
+        then drains before exiting."""
+        from .config import parse_serve_config
+        from .serve import InferenceEngine, ReloadWatcher, ReplicaPool
+        from .serve.engine import restore_inference_blob
         from .serve.server import ServeServer
-        gp = lambda n, d: global_param(self.global_cfg, n, d)
+        sc = parse_serve_config(self.global_cfg)
         # inference-only restore: params + layer state WITHOUT optimizer
         # state (momentum buffers ~double device bytes; an engine never
-        # steps the optimizer) — NOT the training path's _init_model
+        # steps the optimizer) — NOT the training path's _init_model.
+        # The blob is loaded ONCE and placed per replica in fleet mode.
         model_path = None
-        verified = False
+        blob = None
         if self.continue_training:
-            latest = self._agree_latest()
+            latest = self._agree_latest(want_blob=True)
             if latest is not None:
-                model_path = latest[1]
-                verified = True      # find_latest_valid just verified it
-        if model_path is None and self.model_in != "NULL":
+                _r, model_path, blob = latest
+        if blob is None and self.model_in != "NULL":
             model_path = self.model_in
-        if model_path is not None:
-            restore_inference_state(self.trainer, model_path,
-                                    verify=not verified)
-            if not self.silent:
-                print(f"serving model {model_path}", flush=True)
-        else:
-            self.trainer.init_model()
-            if not self.silent:
-                print("serve: no model_in/continue given — serving a "
-                      "RANDOMLY INITIALIZED model (smoke mode)",
-                      flush=True)
-        engine = InferenceEngine(
-            self.trainer,
-            buckets=gp("serve_buckets", "") or None,
-            max_batch=int(gp("serve_max_batch", "64")),
-            cache_size=int(gp("serve_cache_size", "16")),
+            blob = ckpt.load_for_inference(model_path)
+        if blob is not None and not self.silent:
+            print(f"serving model {model_path}", flush=True)
+        if blob is None and not self.silent:
+            print("serve: no model_in/continue given — serving a "
+                  "RANDOMLY INITIALIZED model (smoke mode)", flush=True)
+
+        common = dict(
+            buckets=sc.buckets or None, max_batch=sc.max_batch,
+            cache_size=sc.cache_size,
             # serve_dtype: serving-side compute dtype override (e.g.
-            # serve_dtype=bfloat16 to serve an fp32-trained model at the
-            # bf16 matmul rate); default = the net's compute_dtype policy
-            dtype=gp("serve_dtype", "") or None)
-        srv = ServeServer(
-            engine,
-            port=int(gp("serve_port", "8080")),
-            host=gp("serve_host", "127.0.0.1"),
-            max_latency_ms=float(gp("serve_max_latency_ms", "5")),
-            max_queue_rows=int(gp("serve_queue_rows", "1024")),
-            default_timeout_ms=float(gp("serve_timeout_ms", "0")) or None,
-            log_interval_s=float(gp("serve_log_interval", "30")),
-            # circuit breaker: N consecutive dispatch failures -> fail-fast
-            # 503s until a half-open probe succeeds (0 disables)
-            breaker_threshold=int(gp("serve_breaker_threshold", "5")),
-            breaker_reset_s=float(gp("serve_breaker_reset_s", "10")),
-            degraded_queue_frac=float(gp("serve_degraded_queue_frac",
-                                         "0.8")),
-            # latency SLO (doc/tasks.md "Fleet observability"):
-            # serve_slo_ms=0 disables tracking; burn rate over
-            # serve_slo_burn_degraded flips /healthz to degraded — the
-            # admission-control signal a balancer keys on
-            slo_ms=float(gp("serve_slo_ms", "0")),
-            slo_target=float(gp("serve_slo_target", "0.99")),
-            slo_window_s=float(gp("serve_slo_window_s", "60")),
-            slo_burn_degraded=float(gp("serve_slo_burn_degraded", "2")),
-            silent=bool(self.silent))
+            # serve_dtype=bfloat16 to serve an fp32-trained model at
+            # the bf16 matmul rate); default = the net's policy
+            dtype=sc.dtype or None)
+        watcher = None
+        if sc.fleet:
+            pool = ReplicaPool.build(
+                self.global_cfg, sc.replicas, blob=blob,
+                digest=ckpt.blob_digest(blob["meta"]) if blob else "",
+                admission_control=bool(sc.admission),
+                max_latency_ms=sc.max_latency_ms,
+                max_queue_rows=sc.queue_rows,
+                default_timeout_ms=sc.timeout_ms or None,
+                breaker_threshold=sc.breaker_threshold,
+                breaker_reset_s=sc.breaker_reset_s,
+                degraded_queue_frac=sc.degraded_queue_frac,
+                slo_ms=sc.slo_ms, slo_target=sc.slo_target,
+                slo_window_s=sc.slo_window_s,
+                slo_burn_degraded=sc.slo_burn_degraded,
+                silent=bool(self.silent), **common)
+            if sc.reload_s > 0:
+                # hot reload watches the checkpoint directory a trainer
+                # (this process or another) keeps writing into
+                watcher = ReloadWatcher(
+                    pool, self.model_dir, interval_s=sc.reload_s,
+                    ab_replicas=sc.ab_replicas if sc.ab else 0,
+                    drain_timeout_s=sc.drain_timeout_s,
+                    verbose=not self.silent)
+            srv = ServeServer(
+                pool=pool, reload_watcher=watcher,
+                port=sc.port, host=sc.host,
+                log_interval_s=sc.log_interval_s,
+                silent=bool(self.silent))
+        else:
+            if blob is not None:
+                restore_inference_blob(self.trainer, blob)
+            else:
+                self.trainer.init_model()
+            engine = InferenceEngine(self.trainer, **common)
+            if blob is not None:
+                from .serve.engine import version_name
+                engine.weights_digest = ckpt.blob_digest(blob["meta"])
+                engine.weights_version = version_name(
+                    blob["meta"]["round"])
+            srv = ServeServer(
+                engine,
+                port=sc.port, host=sc.host,
+                max_latency_ms=sc.max_latency_ms,
+                max_queue_rows=sc.queue_rows,
+                default_timeout_ms=sc.timeout_ms or None,
+                log_interval_s=sc.log_interval_s,
+                # circuit breaker: N consecutive dispatch failures ->
+                # fail-fast 503s until a half-open probe succeeds
+                breaker_threshold=sc.breaker_threshold,
+                breaker_reset_s=sc.breaker_reset_s,
+                degraded_queue_frac=sc.degraded_queue_frac,
+                # latency SLO: serve_slo_ms=0 disables tracking; burn
+                # rate over serve_slo_burn_degraded flips /healthz to
+                # degraded — the admission-control signal a balancer
+                # keys on
+                slo_ms=sc.slo_ms, slo_target=sc.slo_target,
+                slo_window_s=sc.slo_window_s,
+                slo_burn_degraded=sc.slo_burn_degraded,
+                silent=bool(self.silent))
         srv.start()
         srv.serve_until_interrupt()
 

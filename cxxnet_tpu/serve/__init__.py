@@ -16,15 +16,26 @@ philosophy to request-driven prediction:
 * :mod:`.stats`   — ``ServingStats``: rolling QPS, latency percentiles,
   batch-fill ratio, compile-cache hit/miss accounting;
 * :mod:`.server`  — stdlib ``http.server`` JSON front-end
-  (``/predict``, ``/extract``, ``/healthz``, ``/statz``).
+  (``/predict``, ``/extract``, ``/healthz``, ``/statz``);
+* :mod:`.fleet`   — ``ReplicaPool``: N engines over disjoint device
+  slices behind an SLO-aware router (queue depth + breaker state +
+  burn rate, admission control, A/B version pinning);
+* :mod:`.reload`  — ``ReloadWatcher``: zero-downtime hot weight reload
+  from the checkpoint directory (verified scan, rolling drain+swap,
+  A/B canary subsets).
 """
 
 from ..resilience import CircuitBreaker, CircuitOpen
 from .engine import InferenceEngine
 from .batcher import MicroBatcher, Backpressure, DeadlineExceeded
 from .stats import ServingStats
+from .fleet import (AllReplicasDegraded, NoHealthyReplica, Replica,
+                    ReplicaPool, UnknownVersion)
+from .reload import ReloadWatcher
 from .server import ServeServer
 
 __all__ = ["InferenceEngine", "MicroBatcher", "Backpressure",
            "DeadlineExceeded", "ServingStats", "ServeServer",
-           "CircuitBreaker", "CircuitOpen"]
+           "CircuitBreaker", "CircuitOpen", "ReplicaPool", "Replica",
+           "ReloadWatcher", "NoHealthyReplica", "AllReplicasDegraded",
+           "UnknownVersion"]

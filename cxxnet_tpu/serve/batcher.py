@@ -81,6 +81,7 @@ class MicroBatcher:
         self._q: "queue.Queue[_Request]" = queue.Queue()
         self._rows_lock = threading.Lock()
         self._queued_rows = 0
+        self._dispatching = 0           # flushes currently past _release
         # admitted-but-undispatched rows, straight off the backpressure
         # accounting (labeled like the ServingStats serve metrics;
         # close() drops the series again)
@@ -158,6 +159,16 @@ class MicroBatcher:
         with self._rows_lock:
             return self._queued_rows
 
+    @property
+    def idle(self) -> bool:
+        """True when nothing is admitted AND no flush is mid-dispatch —
+        the quiesce condition a hot weight reload drains to. queued_rows
+        alone is not enough: _flush releases the row accounting BEFORE
+        the device call, so a reload keyed on it could swap weights under
+        an in-flight dispatch."""
+        with self._rows_lock:
+            return self._queued_rows == 0 and self._dispatching == 0
+
     # -- worker side -----------------------------------------------------
     def _release(self, reqs: List[_Request]) -> None:
         n = sum(r.rows.shape[0] for r in reqs)
@@ -170,26 +181,33 @@ class MicroBatcher:
         at most ``max_batch`` rows (a group can overshoot when the append
         that crossed the threshold was multi-row, and the drain path
         flushes arbitrary backlogs)."""
-        self._release(reqs)
-        now = time.perf_counter()
-        live: List[_Request] = []
-        for r in reqs:
-            if r.deadline is not None and now > r.deadline:
-                self.stats.record_reject("deadline")
-                r.future.set_exception(DeadlineExceeded(
-                    "request expired before dispatch"))
-            else:
-                live.append(r)
-        chunk: List[_Request] = []
-        n_rows = 0
-        for r in live:
-            if chunk and n_rows + r.rows.shape[0] > self.max_batch:
+        with self._rows_lock:
+            # keeps `idle` False across the _release -> dispatch gap
+            self._dispatching += 1
+        try:
+            self._release(reqs)
+            now = time.perf_counter()
+            live: List[_Request] = []
+            for r in reqs:
+                if r.deadline is not None and now > r.deadline:
+                    self.stats.record_reject("deadline")
+                    r.future.set_exception(DeadlineExceeded(
+                        "request expired before dispatch"))
+                else:
+                    live.append(r)
+            chunk: List[_Request] = []
+            n_rows = 0
+            for r in live:
+                if chunk and n_rows + r.rows.shape[0] > self.max_batch:
+                    self._dispatch(chunk)
+                    chunk, n_rows = [], 0
+                chunk.append(r)
+                n_rows += r.rows.shape[0]
+            if chunk:
                 self._dispatch(chunk)
-                chunk, n_rows = [], 0
-            chunk.append(r)
-            n_rows += r.rows.shape[0]
-        if chunk:
-            self._dispatch(chunk)
+        finally:
+            with self._rows_lock:
+                self._dispatching -= 1
 
     def _dispatch(self, live: List[_Request]) -> None:
         """ONE device call for one chunk; scatter results to futures."""

@@ -37,6 +37,26 @@ from .stats import ServingStats
 _KINDS = ("predict", "raw", "extract")
 
 
+def version_name(round_counter: int) -> str:
+    """Canonical model-version id for a checkpoint round (mirrors the
+    ``%04d.model`` filename convention). Weights that never came from a
+    checkpoint are version ``"init"`` — everywhere, so a version pin
+    means the same thing against a single engine and a fleet."""
+    return "r%04d" % int(round_counter)
+
+
+def restore_inference_blob(trainer: Trainer, blob) -> None:
+    """Place an already-loaded inference blob (params + layer state,
+    no optimizer) onto ``trainer`` — shared by the serve driver branch,
+    the fleet pool builder, and :func:`restore_inference_state`."""
+    ckpt.check_structure(blob["meta"],
+                         trainer.graph.structure_signature())
+    trainer.params, trainer.net_state = trainer._place(
+        blob["params"], blob["state"])
+    trainer.round_counter = blob["meta"]["round"]
+    trainer.epoch_counter = blob["meta"]["epoch"]
+
+
 def restore_inference_state(trainer: Trainer, model_path: str,
                             verify: bool = True) -> None:
     """Restore params + layer state onto ``trainer`` from a checkpoint
@@ -45,13 +65,8 @@ def restore_inference_state(trainer: Trainer, model_path: str,
     optimizer) — shared by InferenceEngine.from_checkpoint and the
     ``task = serve`` driver branch. ``verify=False`` when the caller
     just verified the archive (the continue=1 resume scan)."""
-    blob = ckpt.load_for_inference(model_path, verify=verify)
-    ckpt.check_structure(blob["meta"],
-                         trainer.graph.structure_signature())
-    trainer.params, trainer.net_state = trainer._place(
-        blob["params"], blob["state"])
-    trainer.round_counter = blob["meta"]["round"]
-    trainer.epoch_counter = blob["meta"]["epoch"]
+    restore_inference_blob(
+        trainer, ckpt.load_for_inference(model_path, verify=verify))
 
 
 def _parse_buckets(val: Union[str, Sequence[int], None],
@@ -141,6 +156,20 @@ class InferenceEngine:
             raise ValueError(
                 f"serve cache_size must be >= 1, got {self._cache_cap}")
         self._lock = threading.Lock()
+        # weights identity: (params, net_state) must be read as a PAIR at
+        # dispatch time — a hot reload (serve/reload.py) swaps both under
+        # this lock, and a dispatch that read new params with old BN
+        # running stats would serve a model that never existed
+        self._weights_lock = threading.Lock()
+        # weights provenance: the checkpoint round + short digest this
+        # engine is serving, maintained by swap_weights (fleet replicas
+        # surface it as their model version). weights_version stays
+        # "init" until a checkpoint actually lands (from_checkpoint,
+        # swap_weights, or the serve driver's restore) — a random-init
+        # smoke engine must not answer to a round-shaped version pin
+        self.weights_round = int(trainer.round_counter)
+        self.weights_digest = ""
+        self.weights_version = "init"
         self.stats.record_cache(size=0, capacity=self._cache_cap)
 
     # -- construction ----------------------------------------------------
@@ -155,7 +184,9 @@ class InferenceEngine:
             else list(cfg)
         tr = Trainer(pairs)
         restore_inference_state(tr, model_path)
-        return cls(tr, **kw)
+        eng = cls(tr, **kw)
+        eng.weights_version = version_name(tr.round_counter)
+        return eng
 
     # -- shape plumbing --------------------------------------------------
     def _to_input(self, data: np.ndarray) -> np.ndarray:
@@ -274,7 +305,11 @@ class InferenceEngine:
             fn = self._compiled(bucket, kind, node)
             padded = self._pad(rows_nhwc, bucket)
             data = tr.mesh.shard_batch(padded)
-            out = np.asarray(fn(tr.params, tr.net_state, data))
+            # params + net_state read as a pair: a concurrent
+            # swap_weights must never interleave between the two reads
+            with self._weights_lock:
+                params, state = tr.params, tr.net_state
+            out = np.asarray(fn(params, state, data))
         return out[:n]
 
     def _run(self, data, kind: str, node: Optional[str] = None
@@ -302,6 +337,29 @@ class InferenceEngine:
         """Named node activations ('top' = final node) —
         ``task = extract_feature``."""
         return self._run(data, "extract", node_name)
+
+    # -- hot weight reload -----------------------------------------------
+    def swap_weights(self, params, net_state, round_counter: int,
+                     digest: str = "") -> None:
+        """Replace the served weights in place — the hot-reload primitive
+        (serve/reload.py). ``params``/``net_state`` are host pytrees from
+        a verified checkpoint blob; placement uses the SAME sharded-put
+        path a checkpoint restore uses, so TP-sharded engines reload
+        correctly. The compiled executables are untouched: they close
+        over shapes only and take weights as arguments, so a swap costs
+        one device transfer and zero recompiles. Callers are expected to
+        have structure-checked the blob (checkpoint.check_structure) —
+        the reload watcher does."""
+        tr = self.trainer
+        placed_p, placed_s = tr._place(params, net_state)
+        # swap both references under the dispatch read lock so no device
+        # call ever sees new params with old state
+        with self._weights_lock:
+            tr.params, tr.net_state = placed_p, placed_s
+            tr.round_counter = int(round_counter)
+            self.weights_round = int(round_counter)
+            self.weights_digest = digest
+            self.weights_version = version_name(round_counter)
 
     # -- introspection ---------------------------------------------------
     def node_shape(self, node_name: str = "top") -> Tuple[int, int, int]:

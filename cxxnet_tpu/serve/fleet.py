@@ -1,0 +1,559 @@
+"""Serving fleet: a replica pool with SLO-aware routing.
+
+The reference cxxnet's production story was "one binary, many devices" —
+one pthread + CUDA stream per GPU behind a parameter server
+(neural_net-inl.hpp:324-658) — but only for training; serving was
+offline batch predict. This module applies the same shape to ONLINE
+traffic: N independent :class:`InferenceEngine` replicas, each owning a
+slice of the device mesh plus its own micro-batcher, circuit breaker and
+SLO tracker, behind a router that picks per request.
+
+Routing policy (``ReplicaPool.pick``), in order:
+
+1. **version pin** — a request carrying a model version (A/B testing)
+   only considers replicas serving that version;
+2. **availability** — replicas that are draining/reloading/down or whose
+   breaker is open are skipped entirely;
+3. **admission control** — if every available replica is *degraded*
+   (SLO burn rate at/over the paging threshold, or queue near its
+   budget), the request is rejected up front with
+   :class:`AllReplicasDegraded` (HTTP 503): shedding load early is how
+   the error budget stops burning — this is the balancer side of the
+   ``serve_slo_*`` signal (ROADMAP item 3);
+4. **least load** — among the healthy survivors, the replica with the
+   fewest queued rows wins (round-robin rotation breaks ties so equal
+   queues don't starve high-index replicas).
+
+Hot weight reload (serve/reload.py) swaps replicas one at a time: a
+DRAINING replica keeps serving what it already admitted but receives no
+new work, so a rolling reload drops zero requests. A/B pinning falls out
+of the same machinery — reload only a canary subset and two checkpoint
+versions serve side by side, with per-version stats and deterministic
+``version`` routing.
+
+Pure stdlib threading; every public method is thread-safe.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..resilience import CircuitBreaker
+from ..telemetry.ledger import LEDGER
+from ..telemetry.registry import REGISTRY
+from ..telemetry.slo import SLOTracker
+from .batcher import MicroBatcher
+from .engine import InferenceEngine, version_name
+from .stats import ServingStats
+
+# replica lifecycle states (numeric encoding is what the
+# cxxnet_serve_replica_state gauge exports)
+UP, DRAINING, RELOADING, DOWN = "up", "draining", "reloading", "down"
+_STATE_CODE = {UP: 0, DRAINING: 1, RELOADING: 2, DOWN: 3}
+
+_POOL_SEQ = itertools.count()
+
+__all__ = ["Replica", "ReplicaPool", "NoHealthyReplica",
+           "AllReplicasDegraded", "UnknownVersion", "version_name",
+           "UP", "DRAINING", "RELOADING", "DOWN"]
+
+
+class NoHealthyReplica(RuntimeError):
+    """Every candidate replica is out of rotation (down, draining, or
+    breaker-open): fail fast, retry later (HTTP 503)."""
+
+
+class AllReplicasDegraded(NoHealthyReplica):
+    """Admission control: every available replica is degraded (SLO burn
+    / queue saturation) — shed the request instead of making the burn
+    worse (HTTP 503)."""
+
+
+class UnknownVersion(ValueError):
+    """The request pinned a model version no replica serves (HTTP 400)."""
+
+
+class Replica:
+    """One engine + batcher + breaker + SLO tracker, with a lifecycle
+    state the router keys on. Created by :meth:`ReplicaPool.build`."""
+
+    def __init__(self, idx: int, engine: InferenceEngine,
+                 batcher: MicroBatcher,
+                 breaker: Optional[CircuitBreaker],
+                 slo: Optional[SLOTracker],
+                 degraded_queue_frac: float = 0.8,
+                 slo_burn_degraded: float = 2.0):
+        self.idx = int(idx)
+        self.engine = engine
+        self.batcher = batcher
+        self.breaker = breaker
+        self.slo = slo
+        self.degraded_queue_frac = float(degraded_queue_frac)
+        self.slo_burn_degraded = float(slo_burn_degraded)
+        self._state = UP
+        # serializes request admission against lifecycle transitions:
+        # a submit holds it across the state/version re-check AND the
+        # batcher enqueue, and a reload takes it to flip DRAINING — so
+        # a request picked for version X can never be admitted after
+        # the replica started draining toward version Y (the
+        # pick-to-submit TOCTOU)
+        self.admission_lock = threading.Lock()
+        self._g_state = REGISTRY.gauge(
+            "cxxnet_serve_replica_state",
+            "Replica lifecycle state (0=up 1=draining 2=reloading 3=down)",
+            labels=("engine",)).labels(engine.stats.instance)
+        self._g_state.set(0)
+
+    # -- state -----------------------------------------------------------
+    @property
+    def version(self) -> str:
+        """The model version this replica serves — one source of truth
+        (the engine's weights provenance), so a swap can never leave
+        router-visible version state out of sync with the weights."""
+        return self.engine.weights_version
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def set_state(self, new: str) -> None:
+        """Lifecycle transition + gauge + ledger ``replica_state`` event
+        (the serving analog of breaker_transition)."""
+        if new not in _STATE_CODE:
+            raise ValueError(f"unknown replica state {new!r}")
+        old, self._state = self._state, new
+        if old == new:
+            return
+        self._g_state.set(_STATE_CODE[new])
+        LEDGER.event("replica_state", replica=self.idx,
+                     engine=self.engine.stats.instance,
+                     from_state=old, to_state=new, version=self.version)
+
+    # -- router signals --------------------------------------------------
+    def alive(self) -> bool:
+        return self.batcher._thread.is_alive()
+
+    def available(self) -> bool:
+        """In rotation: UP, worker alive, breaker not hard-open. A
+        breaker past its reset timeout reads half_open and stays
+        available — the recovery probe needs a trickle of traffic."""
+        if self._state != UP or not self.alive():
+            return False
+        return self.breaker is None \
+            or self.breaker.effective_state() != "open"
+
+    def queue_frac(self) -> float:
+        return self.batcher.queued_rows / max(1, self.batcher.max_queue_rows)
+
+    def burn_rate(self) -> float:
+        return self.slo.burn_rate() if self.slo is not None else 0.0
+
+    def degraded(self) -> bool:
+        """Impaired but still serving: the admission-control predicate.
+        Mirrors the single-engine /healthz degraded clause (queue near
+        budget, breaker probing, SLO burn at/over the paging line)."""
+        if self.breaker is not None \
+                and self.breaker.effective_state() == "half_open":
+            return True
+        return self.queue_frac() >= self.degraded_queue_frac \
+            or self.burn_rate() >= self.slo_burn_degraded
+
+    def health(self) -> str:
+        """``ok | degraded | open | down`` — same vocabulary as the
+        single-engine /healthz (a draining/reloading replica reads
+        degraded: deliberately impaired, not broken)."""
+        if not self.alive():
+            return "down"
+        if self.breaker is not None \
+                and self.breaker.effective_state() == "open":
+            return "open"
+        if self._state == DOWN:
+            return "down"
+        if self._state in (DRAINING, RELOADING) or self.degraded():
+            return "degraded"
+        return "ok"
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Per-replica /statz row: identity + routing signals + the full
+        single-engine stats snapshot nested under ``stats``."""
+        out = {
+            "replica": self.idx,
+            "engine_instance": self.engine.stats.instance,
+            "state": self._state,
+            "status": self.health(),
+            "version": self.version,
+            "weights_round": self.engine.weights_round,
+            "weights_digest": self.engine.weights_digest,
+            "queued_rows": self.batcher.queued_rows,
+            "queue_frac": round(self.queue_frac(), 4),
+            "devices": self.engine.trainer.mesh.num_devices,
+            "stats": self.engine.stats.snapshot(),
+        }
+        if self.breaker is not None:
+            out["breaker"] = self.breaker.snapshot()
+        if self.slo is not None:
+            out["slo"] = self.slo.snapshot()
+        return out
+
+    def close(self, drain: bool = True) -> None:
+        self.set_state(DOWN)
+        self.batcher.close(drain=drain)
+        self.engine.stats.unregister()
+        fam = REGISTRY.get("cxxnet_serve_replica_state")
+        if fam is not None:
+            fam.remove_labels(self.engine.stats.instance)
+
+
+class ReplicaPool:
+    """N replicas + the router. Build with :meth:`build` (device-slice
+    partitioning) or pass pre-built replicas directly (tests)."""
+
+    def __init__(self, replicas: Sequence[Replica],
+                 admission_control: bool = True):
+        if not replicas:
+            raise ValueError("replica pool needs at least one replica")
+        self.replicas: List[Replica] = list(replicas)
+        self.admission_control = bool(admission_control)
+        self.instance = str(next(_POOL_SEQ))
+        self._lock = threading.Lock()
+        self._rr = 0
+        # per-version terminal-outcome accounting (the A/B comparison
+        # readout): version -> {requests, ok, failed, lat_sum}
+        self._vstats: Dict[str, Dict[str, float]] = {}
+        self._c_version = REGISTRY.counter(
+            "cxxnet_serve_version_requests_total",
+            "Pool requests by model version and outcome",
+            labels=("pool", "version", "result"))
+
+    # -- construction ----------------------------------------------------
+    @classmethod
+    def build(cls, cfg: Any, n_replicas: int, *,
+              blob: Optional[Dict[str, Any]] = None,
+              digest: str = "",
+              devices: Optional[Sequence] = None,
+              admission_control: bool = True,
+              buckets: Any = None, max_batch: int = 64,
+              cache_size: int = 16, dtype: Optional[str] = None,
+              max_latency_ms: float = 5.0, max_queue_rows: int = 1024,
+              default_timeout_ms: Optional[float] = None,
+              breaker_threshold: int = 5, breaker_reset_s: float = 10.0,
+              degraded_queue_frac: float = 0.8,
+              slo_ms: float = 0.0, slo_target: float = 0.99,
+              slo_window_s: float = 60.0,
+              slo_burn_degraded: float = 2.0,
+              silent: bool = False) -> "ReplicaPool":
+        """Build ``n_replicas`` engines over disjoint device slices.
+
+        With >= n devices, each replica gets a contiguous
+        ``len(devices) // n`` slice (equal slices, so every replica
+        shares one bucket ladder); with fewer devices than replicas,
+        replicas share devices round-robin — still useful on CPU, where
+        extra replicas overlap host-side batching with device compute
+        and give the reload/AB machinery real parallelism to work
+        against.
+
+        ``blob`` is an already-verified inference checkpoint blob
+        (``checkpoint.load_for_inference`` / ``find_latest_valid``):
+        loaded ONCE on the host, placed per replica — N replicas never
+        re-read (or re-hash) the archive N times. Without a blob the
+        replicas serve freshly initialized weights (smoke mode, same
+        contract as the single-engine path).
+        """
+        import jax
+        from ..config import parse_config_string
+        from ..parallel import make_mesh_context
+        from ..trainer import Trainer
+        from .engine import restore_inference_blob
+
+        n = int(n_replicas)
+        if n < 1:
+            raise ValueError(f"serve_replicas must be >= 1, got {n}")
+        pairs = parse_config_string(cfg) if isinstance(cfg, str) \
+            else list(cfg)
+        devs = list(devices if devices is not None else jax.devices())
+        if len(devs) >= n:
+            per = len(devs) // n
+            groups = [devs[i * per:(i + 1) * per] for i in range(n)]
+            if len(devs) % n and not silent:
+                # equal slices keep every replica on one bucket ladder
+                # (buckets must divide the per-replica dp degree), so
+                # the remainder idles — say so instead of silently
+                # serving on a fraction of the fleet
+                print(f"serve-fleet: {len(devs) % n} of {len(devs)} "
+                      f"devices idle ({n} replicas x {per} devices "
+                      "each); pick serve_replicas dividing the device "
+                      "count to use them all", flush=True)
+        else:
+            groups = [[devs[i % len(devs)]] for i in range(n)]
+
+        replicas: List[Replica] = []
+        version = "init"
+        if blob is not None:
+            version = version_name(blob["meta"]["round"])
+        for i, group in enumerate(groups):
+            tr = Trainer(pairs, mesh_ctx=make_mesh_context(devices=group))
+            if blob is not None:
+                restore_inference_blob(tr, blob)
+            else:
+                tr.init_model()
+                # an engine never steps the optimizer; N replicas of
+                # momentum buffers would be pure waste
+                tr.opt_state = None
+            engine = InferenceEngine(
+                tr, buckets=buckets, max_batch=max_batch,
+                cache_size=cache_size, dtype=dtype)
+            if blob is not None:
+                engine.weights_digest = digest
+                engine.weights_version = version
+            breaker = (CircuitBreaker(failure_threshold=breaker_threshold,
+                                      reset_timeout_s=breaker_reset_s)
+                       if breaker_threshold > 0 else None)
+            slo = None
+            if slo_ms > 0:
+                slo = SLOTracker(slo_ms, target=slo_target,
+                                 window_s=slo_window_s,
+                                 instance=engine.stats.instance)
+                engine.stats.slo = slo
+            batcher = MicroBatcher(
+                engine, max_latency_ms=max_latency_ms,
+                max_queue_rows=max_queue_rows,
+                default_timeout_ms=default_timeout_ms,
+                breaker=breaker)
+            replicas.append(Replica(
+                i, engine, batcher, breaker, slo,
+                degraded_queue_frac=degraded_queue_frac,
+                slo_burn_degraded=slo_burn_degraded))
+        return cls(replicas, admission_control=admission_control)
+
+    # -- routing ---------------------------------------------------------
+    def versions(self) -> Dict[str, List[int]]:
+        """version -> replica indices currently serving it."""
+        out: Dict[str, List[int]] = {}
+        for r in self.replicas:
+            out.setdefault(r.version, []).append(r.idx)
+        return out
+
+    def pick(self, version: Optional[str] = None) -> Replica:
+        """Route one request (see module docstring for the policy)."""
+        cands = [r for r in self.replicas
+                 if version is None or r.version == version]
+        if version is not None and not cands:
+            raise UnknownVersion(
+                f"no replica serves model version {version!r}; "
+                f"available: {sorted(self.versions())}")
+        avail = [r for r in cands if r.available()]
+        if not avail:
+            raise NoHealthyReplica(
+                "no replica available"
+                + (f" for version {version!r}" if version else "")
+                + ": all down, draining, or breaker-open — retry later")
+        healthy = [r for r in avail if not r.degraded()]
+        if not healthy:
+            if self.admission_control:
+                raise AllReplicasDegraded(
+                    "admission control: every available replica is "
+                    "degraded (SLO burn / queue saturation) — "
+                    "shedding load, retry later")
+            healthy = avail
+        with self._lock:
+            self._rr += 1
+            rr = self._rr
+        n = len(self.replicas)
+        # least queued rows; round-robin rotation breaks ties
+        return min(healthy, key=lambda r: (r.batcher.queued_rows,
+                                           (r.idx - rr) % n))
+
+    def submit(self, data, kind: str = "predict",
+               node: Optional[str] = None,
+               timeout_ms: Optional[float] = None,
+               version: Optional[str] = None):
+        """Route + enqueue one request; returns the result Future. The
+        pick is re-validated under the replica's admission lock before
+        the enqueue: a reload flipping the replica to DRAINING (or
+        swapping its version) between pick() and submit would otherwise
+        serve a version-pinned request from the wrong model. The
+        per-version outcome accounting hangs off the future so A/B
+        comparisons see terminal results, not admissions."""
+        for _ in range(8):            # re-pick bound: reloads are rare
+            rep = self.pick(version)
+            with rep.admission_lock:
+                if rep.state != UP or (version is not None
+                                       and rep.version != version):
+                    continue          # lost a race with a reload
+                ver = rep.version
+                fut = rep.batcher.submit(data, kind, node,
+                                         timeout_ms=timeout_ms)
+                break
+        else:
+            raise NoHealthyReplica(
+                "could not admit request: replicas kept transitioning "
+                "(reload storm?) — retry later")
+        t0 = time.perf_counter()
+        with self._lock:
+            vs = self._vstats.setdefault(
+                ver, {"requests": 0, "ok": 0, "failed": 0, "lat_sum": 0.0})
+            vs["requests"] += 1
+
+        def _done(f):
+            ok = f.exception() is None
+            with self._lock:
+                vs["ok" if ok else "failed"] += 1
+                if ok:
+                    vs["lat_sum"] += time.perf_counter() - t0
+            self._c_version.labels(self.instance, ver,
+                                   "ok" if ok else "failed").inc()
+        fut.add_done_callback(_done)
+        return fut
+
+    # -- reload hooks (serve/reload.py drives these) ---------------------
+    def reload_replica(self, idx: int, params, net_state,
+                       round_counter: int, digest: str = "",
+                       drain_timeout_s: float = 30.0) -> int:
+        """Swap one replica's weights with graceful drain: DRAINING
+        takes it out of rotation (its admitted work still completes),
+        the swap happens only once the batcher is quiescent, and the
+        replica returns UP — zero dropped requests. On drain timeout the
+        swap proceeds anyway (the engine's weights lock keeps any
+        straggling dispatch consistent). Returns the OLD round."""
+        rep = self.replicas[int(idx)]
+        old_round = rep.engine.weights_round
+        # DRAINING flips under the admission lock: after this, no
+        # already-picked request can still be admitted (fleet.submit
+        # re-checks state under the same lock), so batcher.idle really
+        # does mean quiescent
+        with rep.admission_lock:
+            rep.set_state(DRAINING)
+        try:
+            deadline = time.perf_counter() + drain_timeout_s
+            while not rep.batcher.idle \
+                    and time.perf_counter() < deadline:
+                time.sleep(0.002)
+            rep.set_state(RELOADING)
+            rep.engine.swap_weights(params, net_state, round_counter,
+                                    digest)
+        finally:
+            rep.set_state(UP)
+        return old_round
+
+    def newest_round(self) -> int:
+        """Newest checkpoint round any replica serves (-1 when every
+        replica still serves init weights) — the reload watcher's
+        "is this checkpoint new" reference point."""
+        rounds = [r.engine.weights_round for r in self.replicas
+                  if r.version != "init"]
+        return max(rounds) if rounds else -1
+
+    # -- aggregate views -------------------------------------------------
+    def health(self) -> Dict[str, Any]:
+        """Fleet health: the WORST replica decides the top-level status
+        (conservative by design — a fleet hiding a sick replica behind
+        an 'ok' is how slow-burn incidents stay invisible); per-replica
+        statuses ride along so operators see which one."""
+        rank = {"ok": 0, "degraded": 1, "open": 2, "down": 3}
+        statuses = [r.health() for r in self.replicas]
+        worst = max(statuses, key=lambda s: rank[s])
+        return {
+            "status": worst,
+            "replicas": [
+                {"replica": r.idx, "status": s, "state": r.state,
+                 "version": r.version,
+                 "queued_rows": r.batcher.queued_rows,
+                 "burn_rate": round(r.burn_rate(), 4)}
+                for r, s in zip(self.replicas, statuses)],
+            "versions": self.versions(),
+        }
+
+    def version_stats(self) -> Dict[str, Dict[str, Any]]:
+        """Per-version outcome stats. Currently-served versions always
+        appear (a freshly rolled canary with zero traffic yet must show
+        up in /statz); retired versions keep their numbers for the A/B
+        comparison."""
+        serving = self.versions()
+        with self._lock:
+            out = {}
+            for ver in set(serving) | set(self._vstats):
+                vs = self._vstats.get(
+                    ver, {"requests": 0, "ok": 0, "failed": 0,
+                          "lat_sum": 0.0})
+                done = vs["ok"]
+                out[ver] = {
+                    "replicas": serving.get(ver, []),
+                    "requests": int(vs["requests"]),
+                    "ok": int(vs["ok"]),
+                    "failed": int(vs["failed"]),
+                    "mean_ms": round(1e3 * vs["lat_sum"] / done, 3)
+                    if done else 0.0,
+                }
+            return out
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Aggregate /statz payload: the single-engine key layout at the
+        top level (summed across replicas, percentiles over the pooled
+        latency reservoirs) so PR-1 clients and dashboards keep working,
+        plus ``replicas`` / ``versions`` breakdowns."""
+        stats = [r.engine.stats for r in self.replicas]
+        lat = sorted(s for st in stats for s in st.latency_samples())
+        pct = ServingStats._pct
+        uptime = max(st.snapshot_uptime() for st in stats)
+        rows_real = sum(st.rows_real for st in stats)
+        rows_padded = sum(st.rows_padded for st in stats)
+        b_disp = sum(st.batches_dispatched for st in stats)
+        req_batched = sum(st.requests_batched for st in stats)
+        out = {
+            "uptime_s": round(uptime, 3),
+            "requests": {
+                "total": sum(st.requests_total for st in stats),
+                "ok": sum(st.requests_ok for st in stats),
+                "rejected_backpressure":
+                    sum(st.rejected_backpressure for st in stats),
+                "rejected_deadline":
+                    sum(st.rejected_deadline for st in stats),
+                "rejected_breaker":
+                    sum(st.rejected_breaker for st in stats),
+                "failed": sum(st.failed for st in stats),
+            },
+            "qps": round(sum(st.recent_qps() for st in stats), 3),
+            "latency_ms": {
+                "p50": round(1e3 * pct(lat, 0.50), 3),
+                "p95": round(1e3 * pct(lat, 0.95), 3),
+                "p99": round(1e3 * pct(lat, 0.99), 3),
+                "mean": round(1e3 * sum(lat) / len(lat), 3) if lat
+                        else 0.0,
+                "samples": len(lat),
+            },
+            "batches": {
+                "dispatched": b_disp,
+                "coalesced_ge2":
+                    sum(st.batches_coalesced_ge2 for st in stats),
+                "avg_requests_per_batch":
+                    round(req_batched / b_disp, 3) if b_disp else 0.0,
+                "fill_ratio": round(rows_real / rows_padded, 4)
+                if rows_padded else 0.0,
+                "rows_real": rows_real,
+                "rows_padded": rows_padded,
+            },
+            "compile_cache": {
+                "hits": sum(st.cache_hits for st in stats),
+                "misses": sum(st.cache_misses for st in stats),
+                "evictions": sum(st.cache_evictions for st in stats),
+                "size": sum(st.cache_size for st in stats),
+                "capacity": sum(st.cache_capacity for st in stats),
+            },
+            "replicas": [r.snapshot() for r in self.replicas],
+            "versions": self.version_stats(),
+        }
+        return out
+
+    # -- lifecycle -------------------------------------------------------
+    def close(self, drain: bool = True) -> None:
+        for r in self.replicas:
+            r.close(drain=drain)
+        fam = REGISTRY.get("cxxnet_serve_version_requests_total")
+        if fam is not None:
+            with self._lock:
+                for ver in self._vstats:
+                    for res in ("ok", "failed"):
+                        fam.remove_labels(self.instance, ver, res)

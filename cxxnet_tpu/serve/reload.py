@@ -1,0 +1,213 @@
+"""Zero-downtime hot weight reload for the serving fleet.
+
+Train and serve the same model concurrently: a trainer writes
+``%04d.model`` checkpoints into ``model_dir`` while this watcher polls
+the directory and rolls every new round into the live replica pool —
+one replica at a time, each drained before its weights swap, so traffic
+never sees a dropped request or a half-loaded model.
+
+Safety comes from the PR-3 checkpoint machinery, not from trust in the
+writer: the scan is :func:`checkpoint.find_latest_valid` (sha256-digest
+verification, torn/corrupt archives skipped with fallback a round), so a
+mid-write or truncated checkpoint can never be served. The cheap
+:func:`checkpoint.find_latest` scan runs first — the expensive
+read+verify only happens when the directory actually has a newer round
+than the pool serves.
+
+A/B pinning rides the same path: with ``ab_replicas = k``, a reload
+updates only the k-replica canary subset, leaving the rest on the
+previous version — two model versions serve side by side (per-version
+stats in /statz, deterministic routing via the request's ``version``
+field / ``X-Model-Version`` header) until :meth:`ReloadWatcher.promote`
+(or a non-A/B reload) rolls the rest forward.
+
+Every reload lands a ``weights_reload`` ledger event (old/new round +
+content digest, per replica) between the ``replica_state`` transitions,
+so ``tools/report.py`` renders the serving timeline next to the
+training incident timeline.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from ..telemetry.ledger import LEDGER
+from .. import checkpoint as ckpt
+from .fleet import ReplicaPool, version_name
+
+
+class ReloadWatcher:
+    """Poll ``model_dir`` and roll new checkpoints into ``pool``.
+
+    ``interval_s <= 0`` disables the background thread — the watcher is
+    then a manual handle (``check_once()``), which is what tests and the
+    smoke tool drive for determinism.
+    """
+
+    def __init__(self, pool: ReplicaPool, model_dir: str,
+                 interval_s: float = 30.0,
+                 ab_replicas: int = 0,
+                 drain_timeout_s: float = 30.0,
+                 verbose: bool = False):
+        self.pool = pool
+        self.model_dir = model_dir
+        self.interval_s = float(interval_s)
+        # A/B canary subset size: 0 = plain rolling reload of the whole
+        # pool; k >= 1 = only the first k replicas take the new version
+        # (clamped so at least one replica keeps the old version —
+        # "canary everything" is just a rolling reload)
+        self.ab_replicas = max(0, min(int(ab_replicas),
+                                      len(pool.replicas) - 1))
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.verbose = verbose
+        self.reloads = 0               # completed reload sweeps
+        self.last_error: str = ""
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()  # one reload sweep at a time
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "ReloadWatcher":
+        if self.interval_s > 0 and self._thread is None:
+            self._stop.clear()        # restartable after stop()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="serve-reload")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            # a sweep checks the stop event between replicas, so the
+            # worst case to wait out is one poll plus ONE in-progress
+            # drain — not a whole fleet's worth of drains
+            self._thread.join(timeout=self.interval_s
+                              + self.drain_timeout_s + 30)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.check_once()
+            except Exception as e:    # noqa: BLE001 — watcher must survive
+                # a bad poll (transient IO, mid-write races) must not
+                # kill the watcher; the next tick retries
+                self.last_error = f"{type(e).__name__}: {e}"
+                if self.verbose:
+                    print(f"serve-reload: poll failed: {self.last_error}",
+                          flush=True)
+
+    # -- polling ---------------------------------------------------------
+    def _stale(self, target_round: int) -> List[int]:
+        """Replica indices the next sweep must update: members of the
+        reload scope (canary subset in A/B mode, everyone otherwise)
+        not already serving ``target_round``. Keyed on the VERSION, not
+        just the round scan, so a sweep that failed partway (one
+        replica swapped, the next raised) retries the stragglers on the
+        following tick instead of stranding a mixed-version pool."""
+        scope = range(self.ab_replicas or len(self.pool.replicas))
+        want = version_name(target_round)
+        return [i for i in scope
+                if self.pool.replicas[i].version != want]
+
+    def check_once(self) -> bool:
+        """One poll: returns True when a reload happened. The cheap
+        round scan gates the expensive verify+read — steady state costs
+        one listdir per tick."""
+        latest = ckpt.find_latest(self.model_dir)
+        if latest is None or not self._stale(latest[0]):
+            return False
+        # work to do: verified read (falls back a round on corruption;
+        # returns the blob so replicas never re-read)
+        valid = ckpt.find_latest_valid(self.model_dir, want_blob=True,
+                                       verbose=self.verbose)
+        if valid is None:
+            return False
+        r, path, blob = valid
+        stale = self._stale(r)        # the newest file may not have
+        if not stale:                 # verified; re-check at the round
+            return False              # that actually loaded
+        return self.reload_from_blob(blob, path=path, targets=stale) > 0
+
+    def reload_from_blob(self, blob: Dict[str, Any], path: str = "",
+                         targets: Optional[List[int]] = None,
+                         canary: Optional[bool] = None) -> int:
+        """Roll a verified checkpoint blob into the target replicas, one
+        at a time with graceful drain; returns how many replicas
+        actually swapped. Structure-checked against the first target's
+        graph before any replica is touched (every replica shares the
+        net config). The sweep re-checks the stop event between
+        replicas so teardown never races a long rolling drain — an
+        aborted sweep's stragglers are retried by the stale gate on the
+        next tick (or finished by the next process), and only a sweep
+        that finished every target counts toward ``reloads``.
+        ``canary`` labels the ledger events; default = whether this
+        watcher's reload scope is a canary subset (promote() passes
+        False: promotion converges the fleet, it does not split it)."""
+        meta = blob["meta"]
+        new_round = int(meta["round"])
+        digest = ckpt.blob_digest(meta)
+        targets = (self._stale(new_round) if targets is None
+                   else list(targets))
+        if not targets:
+            return 0
+        if canary is None:
+            canary = bool(self.ab_replicas)
+        done = 0
+        with self._lock:
+            first = self.pool.replicas[targets[0]]
+            ckpt.check_structure(
+                meta, first.engine.trainer.graph.structure_signature())
+            for idx in targets:
+                if self._stop.is_set():
+                    break
+                old_round = self.pool.reload_replica(
+                    idx, blob["params"], blob["state"], new_round,
+                    digest=digest, drain_timeout_s=self.drain_timeout_s)
+                LEDGER.event(
+                    "weights_reload", replica=idx,
+                    old_round=old_round, new_round=new_round,
+                    digest=digest, path=path, canary=canary)
+                done += 1
+            if done == len(targets):
+                self.reloads += 1
+        if self.verbose and done:
+            mode = (f"canary x{done}" if canary else f"all x{done}")
+            tail = "" if done == len(targets) \
+                else f" (aborted; {len(targets) - done} left stale)"
+            print(f"serve-reload: {version_name(new_round)} "
+                  f"({digest or 'no digest'}) -> {mode} replicas{tail}",
+                  flush=True)
+        return done
+
+    def promote(self) -> bool:
+        """A/B promotion: roll EVERY replica behind the newest valid
+        checkpoint forward to it — non-canaries catch up to (or past)
+        the canaries, and a canary that itself fell behind a
+        just-written round moves too, so promotion cannot lose a race
+        against a trainer that kept checkpointing into the same
+        model_dir. Returns True when anything moved."""
+        valid = ckpt.find_latest_valid(self.model_dir, want_blob=True,
+                                       verbose=self.verbose)
+        if valid is None:
+            return False
+        r, path, blob = valid
+        want = version_name(r)
+        behind = [rep.idx for rep in self.pool.replicas
+                  if rep.version != want]
+        if not behind:
+            return False
+        return self.reload_from_blob(blob, path=path, targets=behind,
+                                     canary=False) > 0
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "model_dir": self.model_dir,
+            "interval_s": self.interval_s,
+            "ab_replicas": self.ab_replicas,
+            "reloads": self.reloads,
+            "last_error": self.last_error,
+            "running": self._thread is not None
+            and self._thread.is_alive(),
+        }

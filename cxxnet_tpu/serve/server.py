@@ -1,14 +1,26 @@
-"""Stdlib JSON front-end for the inference engine.
+"""Stdlib JSON front-end for the inference engine / replica fleet.
 
 ``http.server``-based so the engine is drivable end-to-end with zero new
 dependencies (the same reason the IO pipeline is pure stdlib threading):
 
 * ``POST /predict``  ``{"data": [[...], ...], "raw": 0|1,
-  "timeout_ms": N?}`` -> ``{"pred": [...]}`` / ``{"prob": [[...]]}``
+  "timeout_ms": N?, "version": "rNNNN"?}`` -> ``{"pred": [...]}`` /
+  ``{"prob": [[...]]}``
 * ``POST /extract``  ``{"data": ..., "node": "name"}``
   -> ``{"features": [[...]]}``
 * ``GET  /healthz``  -> ``{"status": "ok"|"degraded"|"open"|"down", ...}``
 * ``GET  /statz``    -> the ServingStats snapshot + breaker/queue state
+
+The server fronts either ONE engine (``ServeServer(engine)``, the PR-1
+layout byte-for-byte) or a replica fleet (``ServeServer(pool=...)``,
+serve/fleet.py): with a pool, requests route by version pin -> breaker
+state -> admission control -> least queue depth, ``/healthz`` aggregates
+(the worst replica decides the top-level status, per-replica statuses
+ride along) and ``/statz`` keeps the single-engine key layout at the top
+level (summed) while gaining ``replicas`` / ``versions`` breakdowns.
+A/B version pinning: the ``version`` JSON field or ``X-Model-Version``
+header routes deterministically to replicas serving that checkpoint
+round (unknown version -> 400).
 
 Health semantics (what a load balancer keys routing on):
 
@@ -16,18 +28,23 @@ Health semantics (what a load balancer keys routing on):
 * ``degraded`` (200) — still serving but impaired: the admitted-row
   queue is past ``degraded_queue_frac`` of its budget, the breaker is
   half-open (probing a recovering device), corrupt input records
-  have been skipped this process (``recordio.skipped``), or the
+  have been skipped this process (``recordio.skipped``), the
   latency-SLO burn rate is at/over ``slo_burn_degraded`` (the error
-  budget is being eaten unsustainably fast) — keep routing, start
+  budget is being eaten unsustainably fast), or — fleet mode — a
+  replica is draining/reloading/degraded — keep routing, start
   paging;
 * ``open``     (503) — the circuit breaker is open: dispatches are
   failing and requests are being rejected fast — route elsewhere;
-* ``down``     (500) — the batcher worker is dead.
+* ``down``     (500) — the batcher worker is dead (fleet: the WORST
+  replica is dead; the per-replica list shows which).
 
-Error mapping: malformed request 400, backpressure AND breaker-open 503
+Error mapping: malformed request AND unknown pinned version 400,
+backpressure / breaker-open / no-healthy-replica / admission-shed 503
 (retry later), deadline exceeded 504, engine failure 500. Shutdown is
-graceful: stop accepting, then drain the batcher so queued requests
-still get answers.
+graceful: stop accepting, then drain the batcher(s) so queued requests
+still get answers — and since SIGTERM/SIGINT handlers are installed at
+``start()`` (main thread only), rolling restarts and container stops
+take the same drain path as a programmatic ``stop()``.
 """
 
 from __future__ import annotations
@@ -42,11 +59,12 @@ import numpy as np
 
 from ..resilience import CircuitBreaker, CircuitOpen, counters
 from ..telemetry import PROMETHEUS_CONTENT_TYPE, render_prometheus
-from ..telemetry.ledger import run_info
+from ..telemetry.ledger import LEDGER, run_info
 from ..telemetry.slo import SLOTracker
 from ..telemetry.trace import TRACER
 from .batcher import Backpressure, DeadlineExceeded, MicroBatcher
 from .engine import InferenceEngine
+from .fleet import NoHealthyReplica, ReplicaPool, UnknownVersion
 from .stats import ServingStats
 
 
@@ -113,25 +131,33 @@ def _make_handler(server: "ServeServer"):
                 if data.ndim == 1:       # single instance shorthand
                     data = data[None, :]
                 timeout_ms = req.get("timeout_ms")
+                # A/B pin: JSON field wins over the header (explicit in
+                # the payload beats ambient routing config)
+                version = req.get("version") \
+                    or self.headers.get("X-Model-Version") or None
                 # hard cap so a wedged worker can't hang handler threads
                 # forever (batcher deadlines are the soft mechanism)
                 if self.path == "/extract":
                     node = req.get("node", "top")
-                    fut = server.batcher.submit(data, "extract", node,
-                                                timeout_ms=timeout_ms)
+                    fut = server.submit(data, "extract", node,
+                                        timeout_ms=timeout_ms,
+                                        version=version)
                     out = fut.result(timeout=server.result_timeout_s)
                     with TRACER.span("serve.respond", cat="serve"):
                         self._reply(200, {"node": node,
                                           "features": out.tolist()})
                 else:
                     kind = "raw" if int(req.get("raw", 0)) else "predict"
-                    fut = server.batcher.submit(data, kind,
-                                                timeout_ms=timeout_ms)
+                    fut = server.submit(data, kind,
+                                        timeout_ms=timeout_ms,
+                                        version=version)
                     out = fut.result(timeout=server.result_timeout_s)
                     key = "prob" if kind == "raw" else "pred"
                     with TRACER.span("serve.respond", cat="serve"):
                         self._reply(200, {key: out.tolist()})
-            except (Backpressure, CircuitOpen) as e:
+            except UnknownVersion as e:
+                self._reply(400, {"error": str(e)})
+            except (Backpressure, CircuitOpen, NoHealthyReplica) as e:
                 self._reply(503, {"error": str(e)})
             except DeadlineExceeded as e:
                 self._reply(504, {"error": str(e)})
@@ -145,10 +171,20 @@ def _make_handler(server: "ServeServer"):
 
 
 class ServeServer:
-    """Engine + batcher + HTTP front-end, with a periodic stats log line
-    (the serving analog of the trainer's round metric line)."""
+    """Engine (or replica pool) + HTTP front-end, with a periodic stats
+    log line (the serving analog of the trainer's round metric line).
 
-    def __init__(self, engine: InferenceEngine,
+    Exactly one of ``engine`` / ``pool`` must be given. The single-
+    engine form keeps the PR-1 surface byte-for-byte; the pool form
+    routes through :class:`fleet.ReplicaPool` (each replica owns its
+    batcher/breaker/SLO — the pool-level knobs here are ignored because
+    they were applied per replica at pool build time). An optional
+    ``reload_watcher`` (serve/reload.py) is lifecycle-managed: started
+    with the server, stopped (before the drain) on shutdown, and
+    surfaced in ``/statz`` under ``"reload"``.
+    """
+
+    def __init__(self, engine: Optional[InferenceEngine] = None,
                  port: int = 0, host: str = "127.0.0.1",
                  max_batch: Optional[int] = None,
                  max_latency_ms: float = 5.0,
@@ -164,9 +200,16 @@ class ServeServer:
                  slo_ms: float = 0.0,
                  slo_target: float = 0.99,
                  slo_window_s: float = 60.0,
-                 slo_burn_degraded: float = 2.0):
+                 slo_burn_degraded: float = 2.0,
+                 pool: Optional[ReplicaPool] = None,
+                 reload_watcher=None,
+                 handle_signals: bool = True):
+        if (engine is None) == (pool is None):
+            raise ValueError("ServeServer takes exactly one of "
+                             "engine= or pool=")
         self.engine = engine
-        self.stats: ServingStats = engine.stats
+        self.pool = pool
+        self.reload_watcher = reload_watcher
         self.silent = silent
         self.verbose = verbose
         self.max_body_bytes = max_body_bytes
@@ -180,40 +223,91 @@ class ServeServer:
         # rather than a post-mortem
         self.slo_burn_degraded = float(slo_burn_degraded)
         self.slo: Optional[SLOTracker] = None
-        if slo_ms > 0:
-            self.slo = SLOTracker(slo_ms, target=slo_target,
-                                  window_s=slo_window_s,
-                                  instance=self.stats.instance)
-            self.stats.slo = self.slo
-        # breaker_threshold = 0 disables circuit breaking entirely
-        self.breaker = (CircuitBreaker(failure_threshold=breaker_threshold,
-                                       reset_timeout_s=breaker_reset_s)
-                        if breaker_threshold > 0 else None)
+        self.breaker: Optional[CircuitBreaker] = None
+        self.batcher: Optional[MicroBatcher] = None
+        self.stats: Optional[ServingStats] = None
+        if engine is not None:
+            self.stats = engine.stats
+            if slo_ms > 0:
+                self.slo = SLOTracker(slo_ms, target=slo_target,
+                                      window_s=slo_window_s,
+                                      instance=self.stats.instance)
+                self.stats.slo = self.slo
+            # breaker_threshold = 0 disables circuit breaking entirely
+            self.breaker = (
+                CircuitBreaker(failure_threshold=breaker_threshold,
+                               reset_timeout_s=breaker_reset_s)
+                if breaker_threshold > 0 else None)
+            self.batcher = MicroBatcher(
+                engine, max_batch=max_batch,
+                max_latency_ms=max_latency_ms,
+                max_queue_rows=max_queue_rows,
+                default_timeout_ms=default_timeout_ms, stats=self.stats,
+                breaker=self.breaker)
         # degradation is reported relative to THIS server's lifetime —
         # corrupt records skipped before serving started (e.g. during
         # training in the same process) are not this endpoint's problem
         self._skipped_base = counters.get("recordio.skipped")
-        self.batcher = MicroBatcher(
-            engine, max_batch=max_batch, max_latency_ms=max_latency_ms,
-            max_queue_rows=max_queue_rows,
-            default_timeout_ms=default_timeout_ms, stats=self.stats,
-            breaker=self.breaker)
         self.httpd = ThreadingHTTPServer((host, port), _make_handler(self))
         self.httpd.daemon_threads = True
         self.port = self.httpd.server_address[1]
         self._http_thread: Optional[threading.Thread] = None
         self._log_stop = threading.Event()
         self._log_thread: Optional[threading.Thread] = None
+        # graceful-shutdown plumbing: signal handlers set _stop_evt; a
+        # watcher thread (and/or serve_until_interrupt) runs the actual
+        # stop(), which is idempotent
+        self.handle_signals = bool(handle_signals)
+        self._stop_evt = threading.Event()
+        self._stop_lock = threading.Lock()
+        self._stopped = False
+        self._stop_done = threading.Event()
+        self._prev_handlers: Dict[int, object] = {}
+
+    # -- request routing -------------------------------------------------
+    def submit(self, data, kind: str = "predict",
+               node: Optional[str] = None,
+               timeout_ms: Optional[float] = None,
+               version: Optional[str] = None):
+        """One entry point for both topologies: the pool routes, the
+        single engine goes straight to its batcher (where a version pin
+        only matches the engine's own weights)."""
+        if self.pool is not None:
+            return self.pool.submit(data, kind, node,
+                                    timeout_ms=timeout_ms,
+                                    version=version)
+        if version is not None \
+                and version != self.engine.weights_version:
+            raise UnknownVersion(
+                f"no replica serves model version {version!r}; "
+                f"available: [{self.engine.weights_version!r}]")
+        return self.batcher.submit(data, kind, node,
+                                   timeout_ms=timeout_ms)
 
     # -- health ----------------------------------------------------------
     def health(self) -> Tuple[int, Dict]:
         """``ok | degraded | open | down`` + the signals behind the call
         (see module docstring for the load-balancer semantics)."""
+        skipped = counters.get("recordio.skipped") - self._skipped_base
+        if self.pool is not None:
+            agg = self.pool.health()
+            status = agg["status"]
+            if status == "ok" and skipped > 0:
+                status = "degraded"
+            code = {"ok": 200, "degraded": 200,
+                    "open": 503, "down": 500}[status]
+            out = {
+                "status": status,
+                "ok": status == "ok",       # back-compat boolean
+                "replicas": agg["replicas"],
+                "versions": agg["versions"],
+                "skipped_records": skipped,
+            }
+            return code, out
         alive = self.batcher is not None \
             and self.batcher._thread.is_alive()
         queued = self.batcher.queued_rows if alive else 0
         queue_frac = queued / max(1, self.batcher.max_queue_rows)
-        skipped = counters.get("recordio.skipped") - self._skipped_base
         # effective_state: an open breaker past its reset timeout reads
         # half_open (-> degraded, 200), so a load balancer that drained
         # this node on 503 resumes the trickle of traffic the recovery
@@ -245,14 +339,26 @@ class ServeServer:
         return code, out
 
     def statz(self) -> Dict:
-        """ServingStats snapshot + the resilience state alongside it."""
-        out = self.stats.snapshot()
-        if self.breaker is not None:
-            out["breaker"] = self.breaker.snapshot()
-        if self.slo is not None:
-            out["slo"] = self.slo.snapshot()
-        out["queue"] = {"rows": self.batcher.queued_rows,
-                        "max_rows": self.batcher.max_queue_rows}
+        """Stats snapshot + the resilience state alongside it. Fleet
+        mode keeps the single-engine key layout at the top (aggregated)
+        and adds ``replicas`` / ``versions`` / ``reload``."""
+        if self.pool is not None:
+            out = self.pool.snapshot()
+            out["queue"] = {
+                "rows": sum(r.batcher.queued_rows
+                            for r in self.pool.replicas),
+                "max_rows": sum(r.batcher.max_queue_rows
+                                for r in self.pool.replicas)}
+        else:
+            out = self.stats.snapshot()
+            if self.breaker is not None:
+                out["breaker"] = self.breaker.snapshot()
+            if self.slo is not None:
+                out["slo"] = self.slo.snapshot()
+            out["queue"] = {"rows": self.batcher.queued_rows,
+                            "max_rows": self.batcher.max_queue_rows}
+        if self.reload_watcher is not None:
+            out["reload"] = self.reload_watcher.snapshot()
         out["counters"] = counters.snapshot()
         # run identity: joins this process's scraped/statz numbers with
         # the run ledger and the training task's series (same run_id)
@@ -269,44 +375,133 @@ class ServeServer:
             self._log_thread = threading.Thread(
                 target=self._log_loop, daemon=True, name="serve-statlog")
             self._log_thread.start()
+        if self.reload_watcher is not None:
+            self.reload_watcher.start()
+        if self.handle_signals:
+            self._install_signal_handlers()
+        n_rep = len(self.pool.replicas) if self.pool is not None else 1
+        LEDGER.event(
+            "serve_start", port=self.port, replicas=n_rep,
+            versions=(self.pool.versions() if self.pool is not None
+                      else None),
+            reload_s=(self.reload_watcher.interval_s
+                      if self.reload_watcher is not None else 0))
         if not self.silent:
             print(f"serving on http://{self.httpd.server_address[0]}:"
-                  f"{self.port} (/predict /extract /healthz /statz)",
+                  f"{self.port} (/predict /extract /healthz /statz), "
+                  f"{n_rep} replica(s)",
                   flush=True)
         return self
 
     def _log_loop(self) -> None:
         while not self._log_stop.wait(self.log_interval_s):
-            print(self.stats.log_line(), flush=True)
+            print(self.log_line(), flush=True)
+
+    def log_line(self) -> str:
+        if self.pool is None:
+            return self.stats.log_line()
+        s = self.pool.snapshot()
+        return ("serve-fleet[%dx]\tqps:%.2f\tp50_ms:%.2f\tp99_ms:%.2f"
+                "\tfill:%.3f\tok:%d\tfailed:%d\tversions:%s" % (
+                    len(self.pool.replicas), s["qps"],
+                    s["latency_ms"]["p50"], s["latency_ms"]["p99"],
+                    s["batches"]["fill_ratio"], s["requests"]["ok"],
+                    s["requests"]["failed"],
+                    ",".join(sorted(s["versions"]) or ["init"])))
+
+    # -- signals ---------------------------------------------------------
+    def _install_signal_handlers(self) -> None:
+        """SIGTERM/SIGINT -> the same graceful drain as a programmatic
+        ``stop()``: rolling restarts and container stops must not drop
+        the requests already admitted. Main thread only (CPython's
+        signal contract); embedded servers on other threads simply skip
+        — their host process owns signal policy. The handler restores
+        the previous handlers FIRST (it runs on the main thread, the
+        only place that's legal — a stop() driven from the sigwatch
+        thread could never do it), then sets the event: the first
+        signal drains gracefully, a second one gets the host's
+        original behavior (e.g. force-kill), and a drained server
+        never keeps swallowing the process's signals."""
+        import signal
+        if threading.current_thread() is not threading.main_thread():
+            return
+
+        def _sig(signum, _frame):
+            self._restore_signal_handlers()
+            self._stop_evt.set()
+
+        for signum in (signal.SIGINT, signal.SIGTERM):
+            try:
+                self._prev_handlers[signum] = signal.signal(signum, _sig)
+            except (ValueError, OSError):     # non-main thread race
+                return
+        threading.Thread(target=self._sig_watch, daemon=True,
+                         name="serve-sigwatch").start()
+
+    def _sig_watch(self) -> None:
+        self._stop_evt.wait()
+        self.stop()
+
+    def _restore_signal_handlers(self) -> None:
+        import signal
+        for signum, prev in self._prev_handlers.items():
+            try:
+                signal.signal(signum, prev)
+            except (ValueError, OSError):
+                pass
+        self._prev_handlers = {}
 
     def stop(self) -> None:
-        """Graceful: stop accepting, drain the batcher, then report."""
+        """Graceful + idempotent: stop accepting, stop the reload
+        watcher (a weight swap must not race the teardown), drain the
+        batcher(s), then report. Safe to call from the signal watcher
+        AND serve_until_interrupt at once: the loser of the race BLOCKS
+        until the winner's drain completes — a caller returning early
+        could let the process exit while the daemon sigwatch thread is
+        still mid-drain, dropping admitted requests."""
+        with self._stop_lock:
+            if self._stopped:
+                # no timeout: a large fleet's serial drain can legally
+                # take minutes, and returning early would let the
+                # process exit mid-drain; the winner's finally ALWAYS
+                # sets the event, even when its teardown raises
+                self._stop_done.wait()
+                return
+            self._stopped = True
+        self._stop_evt.set()
         self._log_stop.set()
-        self.httpd.shutdown()
-        self.httpd.server_close()
-        if self._http_thread is not None:
-            self._http_thread.join(timeout=10)
-        self.batcher.close(drain=True)
-        if not self.silent:
-            print(self.stats.log_line(), flush=True)
-        # drop this engine's per-instance series from the registry —
-        # a stopped server's frozen gauges must not be scraped forever
-        self.stats.unregister()
+        try:
+            if self.reload_watcher is not None:
+                self.reload_watcher.stop()
+            self.httpd.shutdown()
+            self.httpd.server_close()
+            if self._http_thread is not None:
+                self._http_thread.join(timeout=10)
+            if self.pool is not None:
+                self.pool.close(drain=True)
+            else:
+                self.batcher.close(drain=True)
+            if not self.silent:
+                print(self.log_line(), flush=True)
+            if self.stats is not None:
+                # drop this engine's per-instance series from the
+                # registry — a stopped server's frozen gauges must not
+                # be scraped forever (pool replicas unregister in
+                # pool.close)
+                self.stats.unregister()
+            if threading.current_thread() is threading.main_thread():
+                self._restore_signal_handlers()
+        finally:
+            self._stop_done.set()
 
     def serve_until_interrupt(self) -> None:
         """Foreground loop for ``task = serve``: block until SIGINT/
-        SIGTERM, then shut down gracefully."""
-        import signal
-        stop = threading.Event()
-
-        def _sig(_signum, _frame):
-            stop.set()
-        prev_int = signal.signal(signal.SIGINT, _sig)
-        prev_term = signal.signal(signal.SIGTERM, _sig)
+        SIGTERM (handlers installed at start(); installed here as a
+        fallback when start() ran with handle_signals=False), then shut
+        down gracefully."""
+        if not self._prev_handlers and not self._stopped:
+            self._install_signal_handlers()
         try:
-            while not stop.wait(0.2):
-                pass
+            self._stop_evt.wait()
         finally:
-            signal.signal(signal.SIGINT, prev_int)
-            signal.signal(signal.SIGTERM, prev_term)
             self.stop()

@@ -236,6 +236,29 @@ class ServingStats:
             self._g_ccap.set(capacity)
 
     # -- reading ---------------------------------------------------------
+    def latency_samples(self) -> List[float]:
+        """Copy of the bounded latency reservoir (seconds) — the fleet
+        pool concatenates these across replicas so aggregate percentiles
+        come from pooled observations, not averaged percentiles."""
+        with self._lock:
+            return list(self._lat)
+
+    def snapshot_uptime(self) -> float:
+        return max(time.time() - self._t0, 1e-9)
+
+    def recent_qps(self) -> float:
+        """Completions per second over the rolling window (the same
+        number snapshot()['qps'] reports)."""
+        now = time.time()
+        uptime = max(now - self._t0, 1e-9)
+        window = min(self.qps_window_s, uptime)
+        if not window:
+            return 0.0
+        cutoff = now - window
+        with self._lock:
+            recent = sum(1 for t in self._done_ts if t >= cutoff)
+        return recent / window
+
     @staticmethod
     def _pct(sorted_vals: List[float], q: float) -> float:
         if not sorted_vals:
@@ -250,14 +273,10 @@ class ServingStats:
         locked registry lookups; the deque copy happens under this
         object's lock and the percentile sort outside it, so a
         monitoring poller never stalls the dispatch hot path."""
-        now = time.time()
         with self._lock:
             lat_raw = list(self._lat)
-            done_ts = list(self._done_ts)
-        uptime = max(now - self._t0, 1e-9)
-        window = min(self.qps_window_s, uptime)
-        cutoff = now - window
-        recent = sum(1 for t in done_ts if t >= cutoff)
+        uptime = self.snapshot_uptime()
+        qps = self.recent_qps()       # one definition of the window
         lat = sorted(lat_raw)
         rows_real, rows_padded = self.rows_real, self.rows_padded
         b_disp, req_batched = self.batches_dispatched, self.requests_batched
@@ -273,7 +292,7 @@ class ServingStats:
                 "rejected_breaker": self.rejected_breaker,
                 "failed": self.failed,
             },
-            "qps": round(recent / window, 3) if window else 0.0,
+            "qps": round(qps, 3),
             "latency_ms": {
                 "p50": round(1e3 * self._pct(lat, 0.50), 3),
                 "p95": round(1e3 * self._pct(lat, 0.95), 3),

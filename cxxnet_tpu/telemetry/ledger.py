@@ -61,6 +61,8 @@ KNOWN_EVENTS = (
     "run_start", "run_end", "round_end", "compile",
     "ckpt_save", "ckpt_load", "rollback", "sentinel_trip",
     "breaker_transition", "hang_dump", "straggler", "recompile_storm",
+    # serving fleet (serve/fleet.py, serve/reload.py, serve/server.py)
+    "serve_start", "weights_reload", "replica_state",
 )
 
 

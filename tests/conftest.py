@@ -90,6 +90,7 @@ QUICK_MODULES = {
     "test_optim.py",
     "test_resilience.py",
     "test_serve.py",
+    "test_serve_fleet.py",
     "test_stream.py",
     "test_telemetry.py",
     "test_tools.py",

@@ -1,0 +1,485 @@
+"""Serving-fleet tests: SLO-aware routing, breaker skip, admission
+control, zero-drop hot reload under load, A/B pinning, aggregated
+health/statz, loadgen percentile math, and graceful signal drain."""
+
+import json
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.config import (ConfigError, parse_config_string,
+                               parse_serve_config)
+from cxxnet_tpu.io.data import create_iterator
+from cxxnet_tpu.trainer import Trainer
+from cxxnet_tpu import checkpoint as ckpt
+from cxxnet_tpu.serve import (AllReplicasDegraded, InferenceEngine,
+                              NoHealthyReplica, ReloadWatcher,
+                              ReplicaPool, ServeServer, UnknownVersion)
+from cxxnet_tpu.serve.fleet import DRAINING, UP, version_name
+from cxxnet_tpu.telemetry.ledger import LEDGER, new_run_id
+from cxxnet_tpu.telemetry.slo import SLOTracker
+
+NET_CFG = """
+netconfig=start
+layer[+1:h1] = fullc:fc1
+  nhidden = 24
+  random_type = xavier
+layer[+1:a1] = relu
+layer[a1->out] = fullc:fc2
+  nhidden = 5
+  random_type = xavier
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,16
+batch_size = 32
+eta = 0.3
+metric = error
+"""
+
+SYN_ITER = """
+iter = synthetic
+num_inst = 256
+batch_size = 32
+num_class = 5
+input_shape = 1,1,16
+seed_data = 3
+"""
+
+
+def rows(n, seed=0):
+    return np.random.RandomState(seed).randn(n, 16).astype(np.float32)
+
+
+def make_pool(n=2, **kw):
+    import jax
+    kw.setdefault("buckets", "2,4,8")
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("max_latency_ms", 5)
+    return ReplicaPool.build(NET_CFG, n, devices=jax.devices()[:n], **kw)
+
+
+def save_round(tmp_path, r, seed=0):
+    """Train-ish checkpoint for round ``r`` (distinct seeds -> distinct
+    weights, so reloads are observable in the outputs)."""
+    tr = Trainer(parse_config_string(NET_CFG + f"seed = {seed}\n"))
+    tr.init_model()
+    tr.round_counter = r
+    path = ckpt.model_path(str(tmp_path), r)
+    tr.save_model(path)
+    return path
+
+
+@pytest.fixture()
+def pool2():
+    p = make_pool(2)
+    yield p
+    p.close()
+
+
+# -- router ---------------------------------------------------------------
+
+def test_router_picks_least_loaded(pool2):
+    # inject queue depths: replica 1 is busier
+    pool2.replicas[0].batcher._queued_rows = 2
+    pool2.replicas[1].batcher._queued_rows = 7
+    assert pool2.pick().idx == 0
+    pool2.replicas[0].batcher._queued_rows = 9
+    assert pool2.pick().idx == 1
+    pool2.replicas[0].batcher._queued_rows = 0
+    pool2.replicas[1].batcher._queued_rows = 0
+
+
+def test_router_round_robins_on_ties(pool2):
+    # equal load must rotate, not starve the higher index
+    picked = {pool2.pick().idx for _ in range(8)}
+    assert picked == {0, 1}
+
+
+def test_router_skips_draining_replica(pool2):
+    pool2.replicas[0].set_state(DRAINING)
+    assert all(pool2.pick().idx == 1 for _ in range(4))
+    pool2.replicas[0].set_state(UP)
+
+
+def test_router_skips_breaker_open_replica(pool2):
+    br = pool2.replicas[0].breaker
+    for _ in range(br.failure_threshold):
+        br.record_failure()
+    assert br.state == "open"
+    assert all(pool2.pick().idx == 1 for _ in range(4))
+    # every replica open -> fail fast, not a hang
+    br1 = pool2.replicas[1].breaker
+    for _ in range(br1.failure_threshold):
+        br1.record_failure()
+    with pytest.raises(NoHealthyReplica):
+        pool2.pick()
+    br.record_success()
+    br1.record_success()
+
+
+def test_admission_control_all_degraded():
+    # injectable clocks so the SLO window math is deterministic
+    clock = [1000.0]
+    pool = make_pool(2)
+    try:
+        for rep in pool.replicas:
+            slo = SLOTracker(10.0, target=0.99, window_s=30,
+                             instance=rep.engine.stats.instance,
+                             clock=lambda: clock[0])
+            rep.slo = slo
+            rep.engine.stats.slo = slo
+        # one replica degraded: still routable (the other serves)
+        for _ in range(20):
+            pool.replicas[0].slo.record(ok=False)
+        assert pool.replicas[0].degraded()
+        assert pool.pick().idx == 1
+        # all replicas burning budget -> shed at admission (HTTP 503)
+        for _ in range(20):
+            pool.replicas[1].slo.record(ok=False)
+        with pytest.raises(AllReplicasDegraded):
+            pool.pick()
+        # escape hatch: admission control off serves degraded replicas
+        pool.admission_control = False
+        assert pool.pick().idx in (0, 1)
+    finally:
+        for rep in pool.replicas:
+            rep.slo.unregister()
+            rep.slo = rep.engine.stats.slo = None
+        pool.close()
+
+
+# -- hot reload -----------------------------------------------------------
+
+def test_reload_under_load_drops_zero_requests(pool2, tmp_path):
+    save_round(tmp_path, 0, seed=1)
+    blob = ckpt.load_for_inference(ckpt.model_path(str(tmp_path), 0))
+    watcher = ReloadWatcher(pool2, str(tmp_path), interval_s=0)
+
+    futs = []
+    stop = threading.Event()
+
+    def load():
+        i = 0
+        while not stop.is_set():
+            futs.append(pool2.submit(rows(1, seed=i)))
+            i += 1
+            time.sleep(0.002)
+
+    t = threading.Thread(target=load)
+    t.start()
+    time.sleep(0.15)                    # traffic flowing
+    watcher.reload_from_blob(blob)      # rolling drain+swap, both
+    time.sleep(0.15)                    # traffic keeps flowing after
+    stop.set()
+    t.join()
+
+    outs = [f.result(timeout=30) for f in futs]     # raises on any drop
+    assert len(outs) > 20
+    assert all(o.shape == (1,) for o in outs)
+    assert {r.version for r in pool2.replicas} == {"r0000"}
+    assert {r.engine.weights_digest for r in pool2.replicas} \
+        == {ckpt.blob_digest(blob["meta"])}
+    # the swapped weights actually serve: replica outputs match a fresh
+    # engine built from the same checkpoint
+    import jax
+    from cxxnet_tpu.parallel import make_mesh_context
+    tr_ref = Trainer(parse_config_string(NET_CFG),
+                     mesh_ctx=make_mesh_context(
+                         devices=jax.devices()[:1]))
+    tr_ref.init_model()
+    from cxxnet_tpu.serve.engine import restore_inference_blob
+    restore_inference_blob(tr_ref, blob)
+    eng_ref = InferenceEngine(tr_ref, buckets="2,4,8", max_batch=8)
+    x = rows(4, seed=99)
+    for rep in pool2.replicas:
+        np.testing.assert_allclose(rep.engine.predict_raw(x),
+                                   eng_ref.predict_raw(x), atol=1e-5)
+    eng_ref.stats.unregister()
+
+
+def test_watcher_poll_gates_on_round(pool2, tmp_path):
+    save_round(tmp_path, 0)
+    blob0 = ckpt.load_for_inference(ckpt.model_path(str(tmp_path), 0))
+    watcher = ReloadWatcher(pool2, str(tmp_path), interval_s=0)
+    assert watcher.check_once() is True          # r0000 is news
+    assert pool2.newest_round() == 0
+    assert watcher.check_once() is False         # nothing newer
+    save_round(tmp_path, 3, seed=7)
+    assert watcher.check_once() is True
+    assert {r.version for r in pool2.replicas} == {"r0003"}
+    assert watcher.reloads == 2
+    del blob0
+
+
+def test_reload_partial_failure_retries_stale(pool2, tmp_path):
+    # a sweep that dies after swapping replica 0 must NOT strand the
+    # pool mixed-version forever: the next poll retries the straggler
+    save_round(tmp_path, 0, seed=1)
+    watcher = ReloadWatcher(pool2, str(tmp_path), interval_s=0)
+    orig = pool2.replicas[1].engine.swap_weights
+    calls = {"n": 0}
+
+    def flaky(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient placement failure")
+        return orig(*a, **kw)
+
+    pool2.replicas[1].engine.swap_weights = flaky
+    try:
+        with pytest.raises(RuntimeError, match="transient"):
+            watcher.check_once()
+        assert pool2.replicas[0].version == "r0000"
+        assert pool2.replicas[1].version == "init"     # mixed...
+        assert pool2.replicas[1].state == UP           # ...but serving
+        assert watcher.check_once() is True            # retry: only #1
+        assert {r.version for r in pool2.replicas} == {"r0000"}
+        assert calls["n"] == 2
+    finally:
+        pool2.replicas[1].engine.swap_weights = orig
+
+
+def test_reload_rejects_mismatched_structure(pool2, tmp_path):
+    # a different layer TYPE changes the structure signature (widths
+    # alone do not — they fail later at placement)
+    other_cfg = NET_CFG.replace("layer[+1:a1] = relu",
+                                "layer[+1:a1] = sigmoid")
+    tr = Trainer(parse_config_string(other_cfg))
+    tr.init_model()
+    path = ckpt.model_path(str(tmp_path), 0)
+    tr.save_model(path)
+    watcher = ReloadWatcher(pool2, str(tmp_path), interval_s=0)
+    blob = ckpt.load_for_inference(path)
+    with pytest.raises(ValueError):
+        watcher.reload_from_blob(blob)
+    # no replica was touched
+    assert {r.version for r in pool2.replicas} == {"init"}
+
+
+# -- A/B pinning ----------------------------------------------------------
+
+def test_ab_pinning_routes_deterministically(tmp_path):
+    pool = make_pool(3)
+    try:
+        save_round(tmp_path, 0, seed=1)
+        watcher = ReloadWatcher(pool, str(tmp_path), interval_s=0,
+                                ab_replicas=1)
+        watcher.check_once()                     # everyone -> r0000?
+        # canary mode: only replica 0 takes the new version
+        assert pool.replicas[0].version == "r0000"
+        assert pool.replicas[1].version == "init"
+        save_round(tmp_path, 1, seed=2)
+        watcher.check_once()
+        assert pool.replicas[0].version == "r0001"
+        assert {pool.replicas[1].version, pool.replicas[2].version} \
+            == {"init"}
+        # pinned requests land ONLY on matching replicas
+        for _ in range(6):
+            assert pool.pick("r0001").idx == 0
+            assert pool.pick("init").idx in (1, 2)
+        with pytest.raises(UnknownVersion):
+            pool.pick("r0042")
+        # per-version stats track terminal outcomes separately
+        pool.submit(rows(1), version="r0001").result(timeout=30)
+        pool.submit(rows(1), version="init").result(timeout=30)
+        vs = pool.version_stats()
+        assert vs["r0001"]["ok"] == 1 and vs["init"]["ok"] == 1
+        assert vs["r0001"]["replicas"] == [0]
+        # promotion rolls the rest forward
+        assert watcher.promote() is True
+        assert {r.version for r in pool.replicas} == {"r0001"}
+        assert watcher.promote() is False        # idempotent
+    finally:
+        pool.close()
+
+
+def test_version_name():
+    assert version_name(7) == "r0007"
+    assert version_name(12345) == "r12345"
+
+
+# -- aggregated health / statz --------------------------------------------
+
+def test_pool_health_worst_replica_decides(pool2):
+    srv = ServeServer(pool=pool2, port=0, log_interval_s=0,
+                      silent=True, handle_signals=False)
+    try:
+        code, hz = srv.health()
+        assert (code, hz["status"]) == (200, "ok")
+        assert len(hz["replicas"]) == 2
+        # one draining replica -> degraded (still 200: traffic flows)
+        pool2.replicas[0].set_state(DRAINING)
+        code, hz = srv.health()
+        assert (code, hz["status"]) == (200, "degraded")
+        pool2.replicas[0].set_state(UP)
+        # one breaker-open replica -> the WORST decides: open, 503
+        br = pool2.replicas[1].breaker
+        for _ in range(br.failure_threshold):
+            br.record_failure()
+        code, hz = srv.health()
+        assert (code, hz["status"]) == (503, "open")
+        statuses = {r["replica"]: r["status"] for r in hz["replicas"]}
+        assert statuses[0] == "ok" and statuses[1] == "open"
+        br.record_success()
+    finally:
+        srv.httpd.server_close()
+
+
+def test_pool_statz_keeps_single_engine_layout(pool2):
+    srv = ServeServer(pool=pool2, port=0, log_interval_s=0,
+                      silent=True, handle_signals=False)
+    try:
+        [pool2.submit(rows(2, seed=i)).result(timeout=30)
+         for i in range(4)]
+        s = srv.statz()
+        # the exact PR-1 single-engine top-level keys, still present
+        for key in ("uptime_s", "requests", "qps", "latency_ms",
+                    "batches", "compile_cache", "queue", "counters",
+                    "run"):
+            assert key in s, f"missing single-engine key {key}"
+        assert s["requests"]["ok"] == 4
+        assert s["latency_ms"]["p99"] >= s["latency_ms"]["p50"] > 0
+        # fleet extensions
+        assert len(s["replicas"]) == 2
+        for r in s["replicas"]:
+            assert r["stats"]["requests"]["ok"] >= 0
+            assert r["status"] in ("ok", "degraded", "open", "down")
+        assert "init" in s["versions"]
+        assert "serve-fleet[2x]" in srv.log_line()
+    finally:
+        srv.httpd.server_close()
+
+
+def test_pool_requires_exactly_one_of_engine_or_pool(pool2):
+    with pytest.raises(ValueError, match="exactly one"):
+        ServeServer()
+
+
+# -- serve_* config namespace ---------------------------------------------
+
+def test_parse_serve_config():
+    sc = parse_serve_config(parse_config_string(
+        "serve_replicas = 4\nserve_reload_s = 30\nserve_ab = 1\n"
+        "serve_ab_replicas = 1\nserve_max_batch = 32\n"))
+    assert sc.replicas == 4 and sc.fleet and sc.ab_replicas == 1
+    assert parse_serve_config([]).fleet is False
+    with pytest.raises(ConfigError, match="unknown serve setting"):
+        parse_serve_config([("serve_replcas", "2")])
+    with pytest.raises(ConfigError, match="at least one replica"):
+        parse_serve_config([("serve_replicas", "2"), ("serve_ab", "1"),
+                            ("serve_ab_replicas", "2")])
+    with pytest.raises(ConfigError, match="serve_replicas"):
+        parse_serve_config([("serve_replicas", "0")])
+
+
+# -- ledger events --------------------------------------------------------
+
+def test_reload_ledger_events(pool2, tmp_path):
+    path = os.path.join(str(tmp_path), "ledger.jsonl")
+    LEDGER.enable(path, new_run_id())
+    try:
+        save_round(tmp_path, 0, seed=1)
+        watcher = ReloadWatcher(pool2, str(tmp_path), interval_s=0)
+        watcher.check_once()
+    finally:
+        LEDGER.disable()
+    events = [json.loads(l) for l in open(path) if l.strip()]
+    wr = [e for e in events if e["event"] == "weights_reload"]
+    assert {e["replica"] for e in wr} == {0, 1}
+    assert all(e["new_round"] == 0 and e["digest"] for e in wr)
+    rs = [(e["replica"], e["from_state"], e["to_state"])
+          for e in events if e["event"] == "replica_state"]
+    assert (0, "up", "draining") in rs
+    assert (0, "reloading", "up") in rs
+
+
+# -- loadgen percentile math ----------------------------------------------
+
+def test_loadgen_percentiles_synthetic_trace():
+    from tools.loadgen import latency_summary, percentile
+    # 1..100 ms, shuffled: nearest-rank percentiles are exact
+    trace_ms = list(range(1, 101))
+    np.random.RandomState(0).shuffle(trace_ms)
+    s = latency_summary([v / 1e3 for v in trace_ms])
+    assert s["samples"] == 100
+    assert s["p50_ms"] == 51.0      # round(0.5 * 99) = 50 -> value 51
+    assert s["p95_ms"] == 95.0
+    assert s["p99_ms"] == 99.0
+    assert s["max_ms"] == 100.0
+    assert abs(s["mean_ms"] - 50.5) < 1e-9
+    assert percentile([], 0.5) == 0.0
+    assert percentile([0.007], 0.99) == 0.007
+    empty = latency_summary([])
+    assert empty["samples"] == 0 and empty["p99_ms"] == 0.0
+
+
+def test_loadgen_statz_fill_delta():
+    from tools.loadgen import statz_fill_delta
+    before = {"batches": {"rows_real": 10, "rows_padded": 20,
+                          "dispatched": 5},
+              "requests": {"failed": 1, "rejected_backpressure": 2,
+                           "rejected_deadline": 0,
+                           "rejected_breaker": 0}}
+    after = {"batches": {"rows_real": 40, "rows_padded": 60,
+                         "dispatched": 15},
+             "requests": {"failed": 1, "rejected_backpressure": 2,
+                          "rejected_deadline": 1,
+                          "rejected_breaker": 0}}
+    d = statz_fill_delta(before, after)
+    assert d["batch_fill"] == 0.75          # (40-10)/(60-20)
+    assert d["dispatches"] == 10
+    assert d["failed"] == 0 and d["rejected"] == 1
+
+
+# -- graceful signal drain ------------------------------------------------
+
+def test_sigterm_triggers_graceful_drain(mesh1):
+    tr = Trainer(parse_config_string(NET_CFG), mesh_ctx=mesh1)
+    tr.init_model()
+    eng = InferenceEngine(tr, buckets="2,4,8", max_batch=8)
+    srv = ServeServer(eng, port=0, max_latency_ms=5_000,
+                      log_interval_s=0, silent=True).start()
+    try:
+        # handlers installed at start() (main thread)
+        handler = signal.getsignal(signal.SIGTERM)
+        assert callable(handler) and \
+            handler is not signal.SIG_DFL, "no SIGTERM handler installed"
+        # park requests behind the long batching window, then "SIGTERM"
+        futs = [srv.submit(rows(1, seed=i)) for i in range(3)]
+        handler(signal.SIGTERM, None)
+        # the signal watcher must drain: every admitted request answers
+        outs = [f.result(timeout=30) for f in futs]
+        assert all(o.shape == (1,) for o in outs)
+        deadline = time.time() + 10
+        while not srv._stopped and time.time() < deadline:
+            time.sleep(0.05)
+        assert srv._stopped
+    finally:
+        srv.stop()
+        srv._restore_signal_handlers()   # main thread: put pytest's back
+    assert signal.getsignal(signal.SIGTERM) is not handler
+
+
+def test_single_engine_version_pin(mesh1):
+    tr = Trainer(parse_config_string(NET_CFG), mesh_ctx=mesh1)
+    tr.init_model()
+    eng = InferenceEngine(tr, buckets="2,4,8", max_batch=8)
+    srv = ServeServer(eng, port=0, max_latency_ms=5, log_interval_s=0,
+                      silent=True, handle_signals=False)
+    try:
+        # un-checkpointed weights are version "init" on EVERY topology
+        # (a round-shaped pin against random weights must not match)
+        out = srv.submit(rows(1), version="init").result(timeout=30)
+        assert out.shape == (1,)
+        with pytest.raises(UnknownVersion):
+            srv.submit(rows(1), version="r0000")
+        with pytest.raises(UnknownVersion):
+            srv.submit(rows(1), version="r0042")
+    finally:
+        srv.httpd.server_close()
+        srv.batcher.close()
+        eng.stats.unregister()

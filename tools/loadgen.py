@@ -1,0 +1,399 @@
+#!/usr/bin/env python
+"""Closed+open-loop load generator for the serve endpoint -> SERVE_r*.json.
+
+Gives serving the same artifact discipline training benches have
+(`BENCH_r*.json`): one JSON file carrying measured sustained-QPS latency
+percentiles and batch-fill, captured against a live `/predict` endpoint
+(single engine or replica fleet — the generator only speaks HTTP).
+
+Two load models, because they answer different questions:
+
+* **closed loop** (`--mode closed`): N workers each keep exactly one
+  request in flight — classic throughput probe. Answers "how fast can
+  this pool go"; latency under closed load self-limits (a slow server
+  slows the offered load), so its percentiles flatter the server.
+* **open loop** (`--mode open`): requests are *scheduled* at a fixed
+  target QPS regardless of how the server is doing, the way real user
+  traffic arrives. Latency is measured from the scheduled arrival time,
+  so queueing delay from a struggling server counts against it —
+  sustained-QPS p50/p99 from this phase are the SLO numbers of record.
+
+`--mode both` (default) runs closed first (it also serves as warmup and
+finds the ceiling), then open at `--qps` (default: 60% of the measured
+closed-loop ceiling — a sustainable operating point, not a meltdown).
+
+Batch fill comes from the `/statz` counter deltas over the open phase,
+so it reflects the measured window only.
+
+Usage:
+  python tools/loadgen.py --url http://127.0.0.1:8080 \
+      [--mode both|closed|open] [--qps N] [--duration 10] \
+      [--concurrency 8] [--rows 1] [--raw] [--version rNNNN] \
+      [--note "..."] [-o SERVE_r01.json]
+
+Exit code is nonzero when any request failed (HTTP >= 400 or transport
+error) — a load bench that silently dropped requests is not a bench.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import queue
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import urlparse
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+# -- percentile math (unit-tested on synthetic traces) -----------------------
+
+def percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank on a pre-sorted list — the same rule ServingStats
+    uses, so loadgen numbers and /statz numbers are comparable."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def latency_summary(latencies_s: List[float]) -> Dict[str, float]:
+    """p50/p95/p99/mean/max in ms from raw second samples."""
+    lat = sorted(latencies_s)
+    if not lat:
+        return {"p50_ms": 0.0, "p95_ms": 0.0, "p99_ms": 0.0,
+                "mean_ms": 0.0, "max_ms": 0.0, "samples": 0}
+    return {
+        "p50_ms": round(1e3 * percentile(lat, 0.50), 3),
+        "p95_ms": round(1e3 * percentile(lat, 0.95), 3),
+        "p99_ms": round(1e3 * percentile(lat, 0.99), 3),
+        "mean_ms": round(1e3 * sum(lat) / len(lat), 3),
+        "max_ms": round(1e3 * lat[-1], 3),
+        "samples": len(lat),
+    }
+
+
+# -- HTTP plumbing ------------------------------------------------------------
+
+class _Endpoint:
+    def __init__(self, url: str):
+        u = urlparse(url)
+        if u.scheme != "http":
+            raise ValueError(f"loadgen speaks plain http, got {url!r}")
+        self.host = u.hostname or "127.0.0.1"
+        self.port = u.port or 80
+
+    def connect(self) -> http.client.HTTPConnection:
+        return http.client.HTTPConnection(self.host, self.port,
+                                          timeout=120)
+
+    def get_json(self, path: str) -> dict:
+        conn = self.connect()
+        try:
+            conn.request("GET", path)
+            r = conn.getresponse()
+            return json.loads(r.read().decode("utf-8"))
+        finally:
+            conn.close()
+
+
+def make_payload(rows: int, width: int, raw: bool = False,
+                 version: Optional[str] = None, seed: int = 0) -> bytes:
+    """One pre-encoded /predict body (all requests share it: the server
+    pads onto shape buckets, so distinct values buy nothing but encode
+    time)."""
+    import numpy as np
+    data = np.random.RandomState(seed).randn(rows, width)
+    req: Dict = {"data": [[round(float(v), 4) for v in r] for r in data]}
+    if raw:
+        req["raw"] = 1
+    if version:
+        req["version"] = version
+    return json.dumps(req).encode("utf-8")
+
+
+class _Collector:
+    """Thread-safe latency/outcome sink shared by worker threads."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.latencies: List[float] = []
+        self.failures = 0
+        self.errors: List[str] = []
+
+    def ok(self, latency_s: float) -> None:
+        with self.lock:
+            self.latencies.append(latency_s)
+
+    def fail(self, err: str) -> None:
+        with self.lock:
+            self.failures += 1
+            if len(self.errors) < 8:
+                self.errors.append(err)
+
+
+def _post_once(conn: http.client.HTTPConnection, body: bytes
+               ) -> Tuple[bool, str]:
+    conn.request("POST", "/predict", body=body,
+                 headers={"Content-Type": "application/json"})
+    r = conn.getresponse()
+    payload = r.read()
+    if r.status != 200:
+        return False, f"HTTP {r.status}: {payload[:120]!r}"
+    return True, ""
+
+
+# -- closed loop --------------------------------------------------------------
+
+def run_closed(url: str, body: bytes, duration_s: float,
+               concurrency: int) -> Dict:
+    """``concurrency`` workers, one request in flight each."""
+    ep = _Endpoint(url)
+    col = _Collector()
+    stop = time.perf_counter() + duration_s
+
+    def worker():
+        conn = ep.connect()
+        try:
+            while time.perf_counter() < stop:
+                t0 = time.perf_counter()
+                try:
+                    ok, err = _post_once(conn, body)
+                except OSError as e:
+                    conn.close()
+                    conn = ep.connect()
+                    col.fail(f"{type(e).__name__}: {e}")
+                    continue
+                if ok:
+                    col.ok(time.perf_counter() - t0)
+                else:
+                    col.fail(err)
+        finally:
+            conn.close()
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(concurrency)]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+    done = len(col.latencies)
+    out = {"mode": "closed", "duration_s": round(wall, 3),
+           "concurrency": concurrency, "requests": done + col.failures,
+           "ok": done, "failures": col.failures,
+           "qps_achieved": round(done / wall, 2) if wall else 0.0}
+    out.update(latency_summary(col.latencies))
+    if col.errors:
+        out["errors"] = col.errors
+    return out
+
+
+# -- open loop ----------------------------------------------------------------
+
+def run_open(url: str, body: bytes, duration_s: float, qps: float,
+             max_workers: int = 64) -> Dict:
+    """Fixed-rate arrivals; latency measured from the SCHEDULED arrival
+    time (a server falling behind pays for its queue). Workers pull
+    scheduled slots from a queue — with all workers busy, the slot
+    waits, and that wait is (correctly) part of the measured latency."""
+    ep = _Endpoint(url)
+    col = _Collector()
+    n = max(1, int(round(duration_s * qps)))
+    interval = 1.0 / qps
+    t0 = time.perf_counter() + 0.05          # small start margin
+    slots: "queue.Queue[Optional[float]]" = queue.Queue()
+    behind = [0]
+    behind_lock = threading.Lock()
+
+    def worker():
+        conn = ep.connect()
+        try:
+            while True:
+                sched = slots.get()
+                if sched is None:
+                    return
+                now = time.perf_counter()
+                if now < sched:
+                    time.sleep(sched - now)
+                elif now - sched > 0.010:
+                    with behind_lock:
+                        behind[0] += 1
+                try:
+                    ok, err = _post_once(conn, body)
+                except OSError as e:
+                    conn.close()
+                    conn = ep.connect()
+                    col.fail(f"{type(e).__name__}: {e}")
+                    continue
+                if ok:
+                    # from scheduled arrival, not send: open-loop truth
+                    col.ok(time.perf_counter() - sched)
+                else:
+                    col.fail(err)
+        finally:
+            conn.close()
+
+    workers = min(max_workers, max(4, int(qps * 2)))
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(workers)]
+    for t in threads:
+        t.start()
+    for i in range(n):
+        slots.put(t0 + i * interval)
+    for _ in threads:
+        slots.put(None)
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    done = len(col.latencies)
+    out = {"mode": "open", "duration_s": round(wall, 3),
+           "qps_target": round(qps, 2), "workers": workers,
+           "requests": done + col.failures, "ok": done,
+           "failures": col.failures,
+           "qps_achieved": round(done / wall, 2) if wall else 0.0,
+           "behind_schedule": behind[0]}
+    out.update(latency_summary(col.latencies))
+    if col.errors:
+        out["errors"] = col.errors
+    return out
+
+
+# -- statz deltas -------------------------------------------------------------
+
+def statz_fill_delta(before: dict, after: dict) -> Dict:
+    """Batch-fill and outcome deltas over a measured window."""
+    def d(path: Tuple[str, ...]) -> float:
+        a, b = after, before
+        for k in path:
+            a = a.get(k, 0) if isinstance(a, dict) else 0
+            b = b.get(k, 0) if isinstance(b, dict) else 0
+        return (a or 0) - (b or 0)
+    real = d(("batches", "rows_real"))
+    padded = d(("batches", "rows_padded"))
+    return {
+        "batch_fill": round(real / padded, 4) if padded else 0.0,
+        "rows_real": int(real), "rows_padded": int(padded),
+        "dispatches": int(d(("batches", "dispatched"))),
+        "failed": int(d(("requests", "failed"))),
+        "rejected": int(d(("requests", "rejected_backpressure"))
+                        + d(("requests", "rejected_deadline"))
+                        + d(("requests", "rejected_breaker"))),
+    }
+
+
+# -- driver -------------------------------------------------------------------
+
+def run_bench(url: str, mode: str = "both", qps: float = 0.0,
+              duration_s: float = 10.0, concurrency: int = 8,
+              rows: int = 1, width: Optional[int] = None,
+              raw: bool = False, version: Optional[str] = None,
+              warmup_s: float = 2.0, note: str = "") -> Dict:
+    """Full bench: optional closed phase, open phase, statz deltas.
+    ``width`` defaults to whatever /statz's engine serves — callers
+    must pass it (the generator cannot infer the input shape)."""
+    if width is None:
+        raise ValueError("loadgen needs --width (flat request row "
+                         "width = c*y*x of the model input)")
+    if mode == "open" and qps <= 0:
+        # the auto target is 60% of the measured closed-loop ceiling;
+        # without a closed phase there is no ceiling, and silently
+        # benching at some tiny default would land a flattering
+        # artifact that misrepresents sustained capacity
+        raise ValueError("--mode open requires an explicit --qps "
+                         "(no closed phase to derive a target from); "
+                         "use --mode both for the auto target")
+    ep = _Endpoint(url)
+    body = make_payload(rows, width, raw=raw, version=version)
+    doc: Dict = {
+        "schema": "cxxnet-serve-bench-v1",
+        "url": url, "mode": mode, "rows_per_request": rows,
+        "note": note,
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    hz = ep.get_json("/healthz")
+    doc["healthz_before"] = hz
+    # warmup: populate every compile-cache cell traffic will hit
+    if warmup_s > 0:
+        run_closed(url, body, warmup_s, max(2, concurrency // 2))
+    phases: Dict[str, Dict] = {}
+    if mode in ("both", "closed"):
+        phases["closed"] = run_closed(url, body, duration_s, concurrency)
+    if mode in ("both", "open"):
+        target = qps
+        if target <= 0:
+            ceiling = phases.get("closed", {}).get("qps_achieved", 0.0)
+            # 60% of the closed-loop ceiling: sustained, not meltdown
+            target = max(1.0, 0.6 * ceiling)
+        s_before = ep.get_json("/statz")
+        phases["open"] = run_open(url, body, duration_s, target)
+        s_after = ep.get_json("/statz")
+        doc["open_window"] = statz_fill_delta(s_before, s_after)
+        doc["replicas"] = len(s_after.get("replicas", [])) or 1
+        if "versions" in s_after:
+            doc["versions"] = sorted(s_after["versions"])
+    doc["phases"] = phases
+    # headline numbers: the open phase when present (sustained-QPS
+    # semantics), the closed phase otherwise
+    head = phases.get("open") or phases.get("closed") or {}
+    doc["qps_sustained"] = head.get("qps_achieved", 0.0)
+    doc["p50_ms"] = head.get("p50_ms", 0.0)
+    doc["p99_ms"] = head.get("p99_ms", 0.0)
+    doc["batch_fill"] = doc.get("open_window", {}).get("batch_fill", 0.0)
+    doc["failures"] = sum(p.get("failures", 0) for p in phases.values())
+    return doc
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--url", required=True,
+                    help="serve endpoint base, e.g. http://127.0.0.1:8080")
+    ap.add_argument("--mode", choices=("both", "closed", "open"),
+                    default="both")
+    ap.add_argument("--qps", type=float, default=0.0,
+                    help="open-loop target QPS (default: 60%% of the "
+                         "measured closed-loop ceiling)")
+    ap.add_argument("--duration", type=float, default=10.0,
+                    help="seconds per phase")
+    ap.add_argument("--concurrency", type=int, default=8,
+                    help="closed-loop workers")
+    ap.add_argument("--rows", type=int, default=1,
+                    help="rows per request")
+    ap.add_argument("--width", type=int, required=True,
+                    help="flat row width (= c*y*x of the model input)")
+    ap.add_argument("--raw", action="store_true",
+                    help="request probability rows instead of classes")
+    ap.add_argument("--version", default="",
+                    help="pin requests to a model version (A/B)")
+    ap.add_argument("--warmup", type=float, default=2.0,
+                    help="warmup seconds before measuring")
+    ap.add_argument("--note", default="",
+                    help="free-text provenance note for the artifact")
+    ap.add_argument("-o", "--out", default="",
+                    help="artifact path (default: stdout only)")
+    args = ap.parse_args(argv)
+    doc = run_bench(args.url, mode=args.mode, qps=args.qps,
+                    duration_s=args.duration,
+                    concurrency=args.concurrency, rows=args.rows,
+                    width=args.width, raw=args.raw,
+                    version=args.version or None,
+                    warmup_s=args.warmup, note=args.note)
+    line = json.dumps(doc, sort_keys=True)
+    print(line)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(json.dumps(doc, indent=2, sort_keys=True) + "\n")
+        print(f"artifact -> {args.out}", file=sys.stderr)
+    return 1 if doc.get("failures") else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

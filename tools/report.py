@@ -9,6 +9,8 @@ happened to this run" without grepping logs —
   * round trajectory (round_end events: images/sec, loss, seconds);
   * incident timeline: sentinel trips, rollbacks, breaker transitions,
     hang dumps (stack excerpt), stragglers, recompile storms;
+  * serving timeline: fleet bring-up, hot weight reloads (old/new
+    round + digest), replica lifecycle transitions;
   * checkpoint activity (saves/loads, failures, IO seconds);
   * step-time + fleet metrics from the LAST telemetry_log snapshot
     (EMAs, per-host straggler ratios, hang/compile counters);
@@ -151,7 +153,9 @@ def section_incidents(events: List[Dict], out: List[str]) -> None:
     out.append("")
     incidents = [e for e in events if e.get("event") not in
                  ("round_end", "compile", "ckpt_save", "ckpt_load",
-                  "run_start", "run_end")]
+                  "run_start", "run_end",
+                  # serving lifecycle renders in its own timeline
+                  "serve_start", "weights_reload", "replica_state")]
     if not incidents:
         out.append("No incidents recorded — clean run.")
         out.append("")
@@ -201,6 +205,51 @@ def section_incidents(events: List[Dict], out: List[str]) -> None:
                            % (len(first) - 12))
             out.append("  ```")
     out.append("")
+
+
+_SERVE_EVENTS = ("serve_start", "weights_reload", "replica_state")
+
+
+def section_serving(events: List[Dict], out: List[str]) -> None:
+    """Serving timeline: fleet bring-up, hot weight reloads, replica
+    lifecycle — rendered next to the training incident timeline so "the
+    canary went degraded right after the r0012 reload" reads off one
+    page."""
+    serving = [e for e in events if e.get("event") in _SERVE_EVENTS]
+    if not serving:
+        return
+    out.append("## Serving timeline")
+    out.append("")
+    for e in serving[:200]:
+        etype = e.get("event")
+        line = "- %s `h%s` **%s**" % (_ts(e.get("ts")),
+                                      e.get("host", 0), etype)
+        if etype == "serve_start":
+            line += ": %s replica(s) on port %s" % (
+                e.get("replicas", "?"), e.get("port", "?"))
+            if e.get("versions"):
+                line += ", versions %s" % e["versions"]
+            if e.get("reload_s"):
+                line += ", hot reload every %ss" % e["reload_s"]
+        elif etype == "weights_reload":
+            line += ": replica %s r%s -> r%s (digest `%s`%s)" % (
+                e.get("replica", "?"), e.get("old_round", "?"),
+                e.get("new_round", "?"), e.get("digest", "?"),
+                ", canary" if e.get("canary") else "")
+        elif etype == "replica_state":
+            line += ": replica %s %s -> %s (%s)" % (
+                e.get("replica", "?"), e.get("from_state", "?"),
+                e.get("to_state", "?"), e.get("version", "?"))
+        out.append(line)
+    out.append("")
+    # reload summary: how many swaps, which versions were served
+    reloads = [e for e in serving if e.get("event") == "weights_reload"]
+    if reloads:
+        versions = sorted({("r%04d" % e["new_round"]) for e in reloads
+                           if isinstance(e.get("new_round"), int)})
+        out.append("%d replica weight swap(s); versions served: %s"
+                   % (len(reloads), ", ".join(versions) or "?"))
+        out.append("")
 
 
 def section_checkpoints(events: List[Dict], out: List[str]) -> None:
@@ -319,6 +368,7 @@ def generate(ledger_path: str, telemetry_log: Optional[str],
     section_identity(events, out)
     section_rounds(events, out)
     section_incidents(events, out)
+    section_serving(events, out)
     section_checkpoints(events, out)
     section_telemetry(snap, out)
     section_bench(bench_paths, out)

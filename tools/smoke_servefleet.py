@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""Serving-fleet smoke check (CPU-safe): replicas + hot reload under load.
+
+End-to-end proof of the ROADMAP-3 serving story, on 2 faked CPU devices:
+
+  1. train one tiny round, checkpoint it (``0000.model``);
+  2. build a 2-replica pool (one device each) behind the HTTP server,
+     with the checkpoint-directory reload watcher polling every 0.5 s;
+  3. drive sustained open-loop load (tools/loadgen.py) against
+     ``/predict``;
+  4. MID-LOAD, write a new checkpoint (``0001.model``) — the watcher
+     must verify it, drain each replica in turn, and swap weights with
+     ZERO failed or rejected requests (asserted from the loadgen result
+     AND the ``/statz`` counters);
+  5. assert both replicas took traffic, every replica ends on the new
+     version, the run ledger carries ``serve_start`` /
+     ``weights_reload`` / ``replica_state`` events, and ``/healthz``
+     aggregates per-replica statuses.
+
+With ``-o PATH`` the loadgen document (plus a ``reload`` section) is
+written as a ``SERVE_r*.json`` artifact — on CPU it must be labeled a
+session estimate per the README evidence policy.
+
+Exits nonzero on any failure.
+Run:  JAX_PLATFORMS=cpu python tools/smoke_servefleet.py [-o SERVE.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# two virtual CPU devices so the replicas really land on DISJOINT mesh
+# slices (set before any jax import; harmless if jax is already up with
+# a different count — replicas then share devices round-robin)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+
+NET_CFG = """
+netconfig=start
+layer[+1:h1] = fullc:fc1
+  nhidden = 32
+  random_type = xavier
+layer[+1:a1] = relu
+layer[a1->out] = fullc:fc2
+  nhidden = 5
+  random_type = xavier
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,16
+batch_size = 64
+eta = 0.3
+dev = cpu
+eval_train = 0
+"""
+
+SYN_ITER = """
+iter = synthetic
+num_inst = 512
+batch_size = 64
+num_class = 5
+input_shape = 1,1,16
+seed_data = 3
+"""
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("-o", "--out", default="",
+                    help="write the SERVE_r*.json artifact here")
+    ap.add_argument("--duration", type=float, default=6.0,
+                    help="open-loop seconds (default 6)")
+    ap.add_argument("--qps", type=float, default=25.0,
+                    help="open-loop target QPS (default 25)")
+    args = ap.parse_args()
+
+    import numpy as np  # noqa: F401  (jax init ordering)
+    from cxxnet_tpu.config import parse_config_string
+    from cxxnet_tpu.io.data import create_iterator
+    from cxxnet_tpu.trainer import Trainer
+    from cxxnet_tpu import checkpoint as ckpt
+    from cxxnet_tpu.serve import ReplicaPool, ReloadWatcher
+    from cxxnet_tpu.serve.server import ServeServer
+    from cxxnet_tpu.telemetry.ledger import LEDGER, new_run_id
+    from tools import loadgen
+
+    with tempfile.TemporaryDirectory() as td:
+        model_dir = os.path.join(td, "models")
+        os.makedirs(model_dir)
+        ledger_path = os.path.join(td, "serve.ledger.jsonl")
+        LEDGER.enable(ledger_path, new_run_id())
+
+        # 1 training round -> 0000.model
+        tr = Trainer(parse_config_string(NET_CFG))
+        tr.init_model()
+        for batch in create_iterator(parse_config_string(SYN_ITER)):
+            tr.update(batch)
+        tr.round_counter = 0
+        path0 = ckpt.model_path(model_dir, 0)
+        tr.save_model(path0)
+
+        blob = ckpt.load_for_inference(path0)
+        pool = ReplicaPool.build(
+            NET_CFG, 2, blob=blob,
+            digest=ckpt.blob_digest(blob["meta"]),
+            buckets="2,4,8", max_batch=8, max_latency_ms=10,
+            slo_ms=0)
+        watcher = ReloadWatcher(pool, model_dir, interval_s=0.5,
+                                drain_timeout_s=10)
+        srv = ServeServer(pool=pool, reload_watcher=watcher,
+                          port=0, log_interval_s=0, silent=True,
+                          handle_signals=False).start()
+        url = f"http://127.0.0.1:{srv.port}"
+        try:
+            hz = loadgen._Endpoint(url).get_json("/healthz")
+            assert hz["status"] == "ok", f"/healthz not ok: {hz}"
+            assert len(hz["replicas"]) == 2, f"expected 2 replicas: {hz}"
+            assert hz["versions"] == {"r0000": [0, 1]}, \
+                f"bad initial versions: {hz['versions']}"
+
+            # sustained open-loop load, with a checkpoint landing mid-run
+            bench: dict = {}
+
+            def run_load():
+                bench.update(loadgen.run_bench(
+                    url, mode="open", qps=args.qps,
+                    duration_s=args.duration, rows=1, width=16,
+                    warmup_s=1.5,
+                    note="CPU smoke (tools/smoke_servefleet.py): "
+                         "session estimate, no accelerator attached"))
+
+            t = threading.Thread(target=run_load)
+            t.start()
+            # let warmup + ~1s of measured load pass, then publish the
+            # new round — the watcher must roll it in under live traffic
+            time.sleep(3.0)
+            for batch in create_iterator(parse_config_string(SYN_ITER)):
+                tr.update(batch)
+            tr.round_counter = 1
+            tr.save_model(ckpt.model_path(model_dir, 1))
+            t_pub = time.perf_counter()
+            t.join()
+
+            # zero dropped requests under load, through the reload
+            assert bench["failures"] == 0, \
+                f"loadgen saw failures: {bench['phases']['open']}"
+            win = bench["open_window"]
+            assert win["failed"] == 0 and win["rejected"] == 0, \
+                f"server counted failures/rejections: {win}"
+            assert bench["qps_sustained"] > 0 and bench["p99_ms"] > 0
+
+            # the reload happened, every replica moved to r0001
+            deadline = time.perf_counter() + 15
+            while watcher.reloads < 1 and time.perf_counter() < deadline:
+                time.sleep(0.1)
+            assert watcher.reloads >= 1, \
+                f"watcher never reloaded: {watcher.snapshot()}"
+            s = srv.statz()
+            vers = {r["version"] for r in s["replicas"]}
+            assert vers == {"r0001"}, f"replicas not on r0001: {vers}"
+            digests = {r["weights_digest"] for r in s["replicas"]}
+            assert digests == {ckpt.blob_digest(
+                ckpt.verify_model(ckpt.model_path(model_dir, 1)))}, \
+                f"digest mismatch after reload: {digests}"
+            # both replicas actually took traffic
+            disp = [r["stats"]["batches"]["dispatched"]
+                    for r in s["replicas"]]
+            assert all(dd >= 1 for dd in disp), \
+                f"a replica served nothing: dispatched={disp}"
+            assert s["requests"]["failed"] == 0, s["requests"]
+            reload_lag = time.perf_counter() - t_pub
+
+            # ledger: serving timeline events from every layer
+            events = [json.loads(l) for l in open(ledger_path)
+                      if l.strip()]
+            kinds = {e["event"] for e in events}
+            for want in ("serve_start", "weights_reload",
+                         "replica_state"):
+                assert want in kinds, f"ledger missing {want}: {kinds}"
+            wr = [e for e in events if e["event"] == "weights_reload"]
+            assert {e["replica"] for e in wr} == {0, 1}, wr
+            assert all(e["old_round"] == 0 and e["new_round"] == 1
+                       for e in wr), wr
+            # drain -> reload -> up transitions per replica
+            rs = [e for e in events if e["event"] == "replica_state"]
+            seq0 = [(e["from_state"], e["to_state"]) for e in rs
+                    if e["replica"] == 0]
+            assert ("up", "draining") in seq0 \
+                and ("reloading", "up") in seq0, seq0
+
+            hz2 = loadgen._Endpoint(url).get_json("/healthz")
+            assert hz2["status"] == "ok", f"post-reload health: {hz2}"
+
+            bench["reload"] = {
+                "replicas": 2,
+                "reloads": watcher.reloads,
+                "versions_after": sorted(vers),
+                "failed_during_reload": 0,
+                "publish_to_assert_s": round(reload_lag, 2),
+            }
+            print("smoke_servefleet OK:", json.dumps({
+                "qps_sustained": bench["qps_sustained"],
+                "p50_ms": bench["p50_ms"], "p99_ms": bench["p99_ms"],
+                "batch_fill": bench["batch_fill"],
+                "dispatched": disp, "reloads": watcher.reloads}))
+            if args.out:
+                with open(args.out, "w", encoding="utf-8") as f:
+                    f.write(json.dumps(bench, indent=2, sort_keys=True)
+                            + "\n")
+                print(f"artifact -> {args.out}")
+        finally:
+            srv.stop()
+            LEDGER.disable()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
