@@ -510,8 +510,14 @@ def compute_bench(tr, image, classes, batch, steps, ref_cost_fn=None):
         # higher arith_intensity), not be asserted
         "hbm_bytes_per_step": cost["bytes_accessed"],
         # whether the fused Pallas kernels were selected for this trainer
-        # (fused_kernels knob x backend x single-device gate)
+        # — the ACTUAL post-gate selection (knob x backend x mesh gate),
+        # not the requested knob (pinned by test_bench_helpers)
         "fused_kernels": bool(tr.net._fused_now()),
+        # islands active: fused kernels running under shard_map on a
+        # multi-device mesh (ISSUE 9) — the fused_ab entry on a mesh
+        # then measures the fusion win on the topology that matters
+        "fused_on_mesh": bool(tr.net._fused_now()
+                              and tr.net.fused_spmd is not None),
         "peak_bf16_tflops": peak,
         "hbm_gbs": hbm_gbs,
         "loss_start": loss_start,
@@ -947,6 +953,7 @@ def main() -> None:
         "arith_intensity": round(c["arith_intensity"], 1),
         "hbm_bytes_per_step": round(c["hbm_bytes_per_step"], 1),
         "fused_kernels": c["fused_kernels"],
+        "fused_on_mesh": c["fused_on_mesh"],
         "loss_start": round(c["loss_start"], 4),
         "loss_end": round(c["loss_end"], 4),
         "n_chips": c["n_chips"],
@@ -970,7 +977,7 @@ def main() -> None:
                                   max(3, steps // 2))
             pick = ("ips", "per_step_ms", "hbm_bytes_per_step",
                     "arith_intensity", "mfu_est", "roofline_pct",
-                    "fused_kernels")
+                    "fused_kernels", "fused_on_mesh")
             fused_ab = {
                 "fused": {k: round(c[k], 3) if isinstance(c[k], float)
                           else c[k] for k in pick},

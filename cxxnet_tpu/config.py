@@ -330,6 +330,63 @@ def parse_serve_config(cfg: ConfigPairs) -> ServeConfig:
     return sc
 
 
+# -- sharding -----------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ShardingConfig:
+    """The rule-driven sharding namespace (doc/tasks.md "Sharding
+    rules"). One validated knob set, same contract as ``serve_*`` /
+    ``telemetry_*``: a typo'd key raises instead of silently training
+    with defaults."""
+    partition_rules: str = ""   # custom rules PREPENDED to the model table
+    fsdp_axis: str = ""         # mesh axis for at-rest param/opt sharding
+    fsdp_min_size: int = 1024   # smallest leaf (elements) worth sharding
+
+
+# mesh axes a config may name for FSDP-style at-rest sharding: the std
+# (GSPMD dp/tp) step only — 'seq'/'pipe' are rejected because the sp/pp
+# steps keep their own placement (and a size-1 axis would silently
+# no-op, violating this namespace's fail-loud contract)
+_FSDP_AXES = ("", "data", "model")
+
+
+def parse_sharding_config(cfg: ConfigPairs) -> ShardingConfig:
+    """Collect/validate ``partition_rules`` / ``fsdp_*`` keys (last
+    occurrence wins; unknown keys in the namespace fail fast)."""
+    known = {
+        "partition_rules": ("partition_rules", str),
+        "fsdp_axis": ("fsdp_axis", str),
+        "fsdp_min_size": ("fsdp_min_size", int),
+    }
+    vals = {}
+    for name, val in cfg:
+        if name.startswith("fsdp_") or name.startswith("partition_rule"):
+            if name not in known:
+                raise ConfigError(
+                    f"unknown sharding setting {name!r}; valid keys: "
+                    + ", ".join(sorted(known)))
+            field, conv = known[name]
+            try:
+                vals[field] = conv(val)
+            except ValueError as e:
+                raise ConfigError(f"bad {name} value {val!r}: {e}")
+    sc = ShardingConfig(**vals)
+    if sc.fsdp_axis not in _FSDP_AXES:
+        raise ConfigError(
+            f"fsdp_axis must be one of {'|'.join(a for a in _FSDP_AXES if a)}"
+            f" (or unset), got {sc.fsdp_axis!r}")
+    if sc.fsdp_min_size < 0:
+        raise ConfigError(
+            f"fsdp_min_size must be >= 0, got {sc.fsdp_min_size}")
+    if sc.partition_rules:
+        from .parallel.rules import parse_rule_string
+        try:
+            parse_rule_string(sc.partition_rules)
+        except ValueError as e:
+            raise ConfigError(f"bad partition_rules value: {e}")
+    return sc
+
+
 # -- IO retry policy ----------------------------------------------------------
 
 @dataclasses.dataclass(frozen=True)

@@ -382,3 +382,14 @@ def policy_from_config(cfg: ConfigPairs) -> Policy:
     from the ``compute_dtype`` global (default float32 — reference
     parity: mshadow real_t, src/global.h)."""
     return parse_policy(global_param(cfg, "compute_dtype", "float32"))
+
+
+def sharding_from_config(cfg: ConfigPairs):
+    """Resolve the rule-driven sharding namespace
+    (:func:`~cxxnet_tpu.config.parse_sharding_config`:
+    ``partition_rules`` / ``fsdp_axis`` / ``fsdp_min_size``) — the
+    graph-level accessor beside :func:`policy_from_config`, so every
+    Network/Trainer build validates the namespace exactly once per
+    config, typos raising at build time like a bad compute_dtype."""
+    from .config import parse_sharding_config
+    return parse_sharding_config(cfg)

@@ -152,6 +152,13 @@ class ApplyCtx:
     # treat it as a hint: unsupported shapes fall back to their jnp
     # reference inside the same apply.
     fused: bool = False
+    # mesh context for the fused kernels (ops.fused.FusedSpmd): set on
+    # multi-device meshes so each fused op runs as a fully-manual
+    # shard_map island (batch dim over the data axis, per-op
+    # collectives) instead of a bare pallas_call GSPMD cannot shard.
+    # None on a single device AND inside already-manual step bodies
+    # (sp/pp), where a bare pallas_call is fine.
+    fused_spmd: Optional[Any] = None
     # activation folded into this layer's epilogue by the graph-level
     # plan (graph.act_fusion_plan): "relu" or None. Layers honoring it
     # MUST apply the activation on their reference path too — the fold

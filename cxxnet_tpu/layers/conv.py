@@ -111,7 +111,7 @@ class ConvolutionLayer(Layer):
             # the conv stays on XLA's MXU lowering, the epilogue runs
             # as one Pallas pass (None -> unsupported shape, fall back)
             from ..ops.fused_epilogue import fused_bias_act
-            fy = fused_bias_act(y, bias, act)
+            fy = fused_bias_act(y, bias, act, spmd=ctx.fused_spmd)
             if fy is not None:
                 return [fy], state
         if bias is not None:
@@ -225,7 +225,8 @@ class _PoolingLayer(Layer):
                 stride=hp.stride, pad=(hp.pad_y, hp.pad_x),
                 extra=(self._extra_y, self._extra_x),
                 reducer="max" if self.reducer == "max" else "sum",
-                scale_avg=self.scale_avg, pre_relu=self.pre_relu)
+                scale_avg=self.scale_avg, pre_relu=self.pre_relu,
+                spmd=ctx.fused_spmd)
             if fy is not None:
                 return [fy], state
         if self.pre_relu:
@@ -352,7 +353,7 @@ class LRNLayer(Layer):
             # against). None -> unsupported shape, jnp path below.
             from ..ops.fused_lrn import fused_lrn
             fy = fused_lrn(x, self.nsize, self.alpha, self.beta,
-                           self.knorm)
+                           self.knorm, spmd=ctx.fused_spmd)
             if fy is not None:
                 return [fy], state
         sq = jnp.square(x)

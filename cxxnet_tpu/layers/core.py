@@ -68,7 +68,8 @@ class FullConnectLayer(Layer):
             # fused bias+activation epilogue (ops/fused_epilogue.py) on
             # the matmul output; None -> unsupported shape, jnp path
             from ..ops.fused_epilogue import fused_bias_act
-            fy = fused_bias_act(_as_node(y), bias, act)
+            fy = fused_bias_act(_as_node(y), bias, act,
+                                spmd=ctx.fused_spmd)
             if fy is not None:
                 return [fy], state
         if bias is not None:

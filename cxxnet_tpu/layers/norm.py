@@ -127,7 +127,8 @@ class _BatchNormBase(Layer):
         from ..ops.fused_norm import fused_bn_act
         return fused_bn_act(x, slope, bias, eps=self.eps,
                             act=ctx.fuse_act or "none",
-                            two_pass=self.two_pass)
+                            two_pass=self.two_pass,
+                            spmd=ctx.fused_spmd)
 
     def apply(self, params, state, inputs, ctx):
         x = inputs[0]

@@ -64,7 +64,18 @@ class Network:
         self.fused_mode = resolve_mode(
             global_param(cfg, "fused_kernels", "auto"))
         self.fused_single_device = True
+        # mesh context (ops.fused.FusedSpmd) the trainer binds on
+        # multi-device meshes: fused ops then run as shard_map islands
+        # with per-op collectives instead of being cleared wholesale
+        self.fused_spmd = None
         self._tp_plan_logged = False
+        # rule-driven sharding (parallel/rules.py): the validated
+        # config namespace (partition_rules / fsdp_*), custom rules
+        # prepended to the generated per-model table
+        from .graph import sharding_from_config
+        self.sharding_cfg = sharding_from_config(cfg)
+        self._rule_pspecs_cache = None
+        self._param_shapes_cache = None
         # build layer objects; shared specs reuse the primary object
         self.layers: List[Layer] = []
         for spec in graph.layers:
@@ -124,9 +135,13 @@ class Network:
 
     def _fused_now(self) -> bool:
         """Per-trace fused-kernel decision: knob/env x backend (ops.
-        fused.kernels_active) x the trainer's single-device gate."""
+        fused.kernels_active) x the trainer's mesh gate — which now
+        either binds a ``fused_spmd`` island context (dp meshes) or
+        clears ``fused_single_device`` (topologies the islands do not
+        cover), never both."""
         from .ops.fused import kernels_active
-        return self.fused_single_device and kernels_active(self.fused_mode)
+        return ((self.fused_single_device or self.fused_spmd is not None)
+                and kernels_active(self.fused_mode))
 
     # -- init --------------------------------------------------------------
     def init(self, key: jax.Array) -> Tuple[Params, NetState]:
@@ -198,6 +213,8 @@ class Network:
                            compute_dtype=cdt,
                            seq_axis=seq_axis, data_axis=data_axis,
                            fused=fused_now,
+                           fused_spmd=self.fused_spmd if fused_now
+                           else None,
                            fuse_act=self._fuse_act.get(li),
                            cin_pad=self._cin_pad.get(li))
             inputs = [nodes[ni] for ni in spec.nindex_in]
@@ -210,6 +227,7 @@ class Network:
                                  seq_axis=_ctx.seq_axis,
                                  data_axis=_ctx.data_axis,
                                  fused=_ctx.fused,
+                                 fused_spmd=_ctx.fused_spmd,
                                  fuse_act=_ctx.fuse_act,
                                  cin_pad=_ctx.cin_pad)
                     return _layer.apply(lp, ls, list(ins), c)
@@ -413,22 +431,27 @@ class Network:
         followed: List[str] = []
 
         def slice_dims(li, layer):
-            """{key: (dim, orig)} for a producer slice, or a reason str."""
+            """{key: (dim, orig)} for a producer slice, or a reason str.
+            Specs come from the RULE TABLE (param_pspecs), not the
+            layer declaration directly — a config ``partition_rules``
+            override changes the manual plan the same way it changes
+            GSPMD placement, keeping the 0.4.x execution fallback
+            derived from the one declarative source."""
             if getattr(layer, "tp_manual_axis", None) is None:
                 return "no tp_manual_axis"
-            pspecs = layer.param_pspecs()
-            if not pspecs:
-                return "no 'model' pspec (e.g. grouped conv)"
-            shapes = jax.eval_shape(
-                lambda _li=li: self.layers[_li].init_params(
-                    jax.random.PRNGKey(0), self._in_shapes_of[_li]))
-            # pspecs may name optional params the layer did not create
-            # (no_bias conv declares a "bias" pspec) — plan what exists
+            pspecs = self.param_pspecs().get(layer.name) or {}
+            shapes = self.param_shapes().get(layer.name, {})
+            # rules cover only params the layer actually created
+            # (no_bias conv has no "bias" leaf to match)
             dims = {key: d for key, ps in pspecs.items() if key in shapes
-                    for d, ax in enumerate(ps) if ax == "model"}
+                    for d, ax in enumerate(ps)
+                    if ax == "model"
+                    or (isinstance(ax, tuple) and "model" in ax)}
+            if not dims:
+                return "no 'model' dim in the partition rules"
             sizes = {shapes[key].shape[d] for key, d in dims.items()}
-            if not dims or len(sizes) != 1:
-                return "mixed/absent 'model' dims"
+            if len(sizes) != 1:
+                return "mixed 'model' dims"
             orig = sizes.pop()
             if orig < tp_size:
                 return f"'model' dim {orig} < tp {tp_size}"
@@ -660,18 +683,62 @@ class Network:
         assert result.nodes is not None, "apply(capture_nodes=True) required"
         return result.nodes[name]
 
-    def param_pspecs(self) -> Dict[str, Any]:
-        """PartitionSpec tree matching init()'s params for tensor-parallel
-        placement over the mesh 'model' axis (size-1 axis = replicated, so
-        this is always safe to apply)."""
-        specs: Dict[str, Any] = {}
+    def param_shapes(self) -> Dict[str, Any]:
+        """ShapeDtypeStruct tree of init()'s params (eval_shape — no
+        values materialize), cached. The rule matcher and the FSDP
+        planner key off this."""
+        if self._param_shapes_cache is None:
+            self._param_shapes_cache = jax.eval_shape(
+                lambda: self.init(jax.random.PRNGKey(0))[0])
+        return self._param_shapes_cache
+
+    def partition_rules(self):
+        """The per-model partition-rule table (parallel/rules.py):
+        custom ``partition_rules`` config entries first (override
+        wins), then ONE anchored rule per parameter leaf — spec from
+        the layer type's declaration (``layer.param_pspecs``), P()
+        (replicated) for everything else. ``(^|/)`` anchoring lets the
+        same table cover optimizer state, whose momentum/moment trees
+        mirror the params under "mom"/"m1"/"m2" prefixes — so params
+        AND optimizer state shard from one declarative source."""
+        import re as _re
+
+        from jax.sharding import PartitionSpec as P
+
+        from .parallel.rules import parse_rule_string, tree_paths
+        rules = (parse_rule_string(self.sharding_cfg.partition_rules)
+                 if self.sharding_cfg.partition_rules else [])
+        # optimizer-state mirrors are the ONLY non-layer prefixes the
+        # generated anchors admit — a bare (^|/) would let one layer's
+        # rule capture a suffix of another layer's NESTED leaf (layer
+        # 'o' vs 'attn1/o/wmat')
+        opt = r"^(?:(?:mom|m1|m2)/)?"
         for spec, layer in zip(self.graph.layers, self.layers):
             if spec.is_shared or not layer.has_params:
                 continue
-            ps = layer.param_pspecs()
-            if ps:
-                specs[layer.name] = ps
-        return specs
+            declared = dict(tree_paths(
+                layer.param_pspecs() or {},
+                is_leaf=lambda v: isinstance(v, tuple))[0])
+            shapes = self.param_shapes().get(layer.name, {})
+            for path, _leaf in tree_paths(shapes)[0]:
+                ps = declared.get(path)
+                rules.append((
+                    opt + rf"{_re.escape(layer.name)}/{_re.escape(path)}$",
+                    P(*ps) if ps is not None else P()))
+        return rules
+
+    def param_pspecs(self) -> Dict[str, Any]:
+        """PartitionSpec tree matching init()'s params, derived from
+        the partition-rule table (size-1 axes = replicated, so this is
+        always safe to apply). The manual-tp plan and the trainer's
+        placement both read THIS — one source of truth; the per-layer
+        ``layer.param_pspecs`` declarations only feed the rule table
+        (asserted equal in tests/test_partition_rules.py)."""
+        if self._rule_pspecs_cache is None:
+            from .parallel.rules import match_partition_rules
+            self._rule_pspecs_cache = match_partition_rules(
+                self.partition_rules(), self.param_shapes())
+        return self._rule_pspecs_cache
 
     # -- introspection -----------------------------------------------------
     def param_tag(self, layer_name: str, param_name: str) -> str:

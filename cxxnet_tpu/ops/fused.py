@@ -16,12 +16,19 @@ Selection contract — the one rule every fused op follows:
 * env ``CXXNET_FUSED_KERNELS`` overrides the config knob with the same
   values (ops-level kill switch that needs no config edit).
 
-Gating beyond the knob (callers, not this module): fused ops are
-single-device only — a ``pallas_call`` is an opaque custom call the
-GSPMD partitioner cannot shard, and the fused BN's moments would be
-shard-local where the jnp path's ``jnp.mean`` is a sync-BN collective.
-The trainer clears ``Network.fused_single_device`` /
-``Optimizer.fused_ok`` on multi-device meshes.
+Gating beyond the knob (callers, not this module): a ``pallas_call``
+is an opaque custom call the GSPMD partitioner cannot shard, so on a
+multi-device mesh every fused op runs inside a fully-MANUAL
+``shard_map`` island (:func:`island`) whose in/out specs shard the
+batch dim over the data axis — per-op collectives (the fused BN's
+moment psum, the epilogue's dbias psum) make the mesh math match the
+GSPMD jnp references exactly (sync-BN stays sync-BN). The trainer
+hands the mesh context to the ops as a :class:`FusedSpmd` via
+``Network.fused_spmd`` / ``Optimizer.fused_spmd``; topologies the
+islands do not cover (pipeline stages, sp x tp) still clear the gate,
+now with a one-time warning and a
+``cxxnet_fused_fallback_total{reason}`` counter (:func:`note_fallback`)
+instead of a silent slow path.
 
 Every fused op returns ``None`` for unsupported shapes/dtypes and the
 caller falls back to its reference implementation, so selection is
@@ -30,8 +37,9 @@ always safe — never an error.
 
 from __future__ import annotations
 
+import dataclasses
 import os
-from typing import Optional
+from typing import Any, Optional, Sequence, Union
 
 import jax
 
@@ -64,6 +72,74 @@ def kernels_active(mode: str) -> bool:
     if mode == "on":
         return True
     return jax.default_backend() == "tpu"
+
+
+@dataclasses.dataclass(frozen=True)
+class FusedSpmd:
+    """Mesh context for shard_map-wrapped fused kernels: the mesh and
+    the axis the batch's leading dim is sharded over. Hashable (Mesh
+    hashes by device assignment) so it can ride custom_vjp
+    nondiff_argnums."""
+    mesh: Any                 # jax.sharding.Mesh
+    batch_axis: str = "data"
+
+    @property
+    def n_shards(self) -> int:
+        return int(self.mesh.shape[self.batch_axis])
+
+
+def island(spmd: FusedSpmd, fn, in_batch: Sequence[bool],
+           out_batch: Union[bool, Sequence[bool]]):
+    """Wrap ``fn`` in a fully-manual shard_map over EVERY mesh axis
+    (via parallel/compat.py, so jax-0.4.x spells it the same way):
+    args flagged True in ``in_batch`` shard their leading dim over
+    ``spmd.batch_axis``, the rest replicate; ``out_batch`` likewise
+    for the outputs (a bare bool for a single output). Inside the
+    island GSPMD never sees the pallas_call — the body is manual —
+    and any cross-shard reduction is the body's own explicit psum."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.compat import shard_map
+    bspec = P(spmd.batch_axis)
+    in_specs = tuple(bspec if b else P() for b in in_batch)
+    if isinstance(out_batch, bool):
+        out_specs: Any = bspec if out_batch else P()
+    else:
+        out_specs = tuple(bspec if b else P() for b in out_batch)
+    return shard_map(fn, mesh=spmd.mesh, in_specs=in_specs,
+                     out_specs=out_specs,
+                     axis_names=set(spmd.mesh.axis_names))
+
+
+def batch_divisible(spmd: Optional[FusedSpmd], leading: int) -> bool:
+    """Whether the batch's leading dim splits evenly over the island's
+    batch axis (callers fall back to their reference otherwise)."""
+    return spmd is None or (spmd.n_shards > 0
+                            and leading % spmd.n_shards == 0)
+
+
+#: reasons already warned about (print once per process, count always)
+_FALLBACK_WARNED = set()
+
+
+def note_fallback(reason: str, warn: Optional[str] = None) -> None:
+    """Record a fused-path fallback: always bumps
+    ``cxxnet_fused_fallback_total{reason}`` in the telemetry registry
+    (visible in /metrics and fleet snapshots), and prints ``warn``
+    once per process — a mesh run that silently loses its fused hot
+    path is exactly the quiet misconfiguration telemetry exists for."""
+    try:
+        from ..telemetry.registry import get_registry
+        get_registry().counter(
+            "cxxnet_fused_fallback_total",
+            "fused kernel suite fallbacks to the reference path, "
+            "by reason", labels=("reason",)).labels(reason).inc()
+    except Exception:
+        pass
+    if warn and reason not in _FALLBACK_WARNED:
+        _FALLBACK_WARNED.add(reason)
+        print(f"fused_kernels: {warn} (reason={reason}; counted in "
+              "cxxnet_fused_fallback_total)", flush=True)
 
 
 def use_interpret(interpret: Optional[bool]) -> bool:

@@ -24,7 +24,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from .fused import (HAVE_PALLAS, row_block, sublane_mult,
+from .fused import (HAVE_PALLAS, FusedSpmd, batch_divisible, island,
+                    note_fallback, row_block, sublane_mult,
                     supported_dtype, use_interpret)
 
 if HAVE_PALLAS:
@@ -156,12 +157,69 @@ def _epi_bias_bwd(act, interpret, bn, res, dy):
 _epi_bias_2d.defvjp(_epi_bias_fwd, _epi_bias_bwd)
 
 
+# -- mesh (shard_map island) variant ------------------------------------------
+#
+# Bias + act over a batch-sharded node: fwd/bwd pallas calls each run
+# inside their own fully-manual island (custom_vjp OUTSIDE the
+# shard_map), and the only collective is the backward's dbias psum
+# over the data axis — a replicated bias's gradient is the sum of the
+# shard-local column reductions. Act-only epilogues have no
+# replicated operand at all and simply island-wrap the existing
+# custom_vjp (all specs batch-sharded, so the shard_map transpose is
+# collective-free and exact).
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _epi_bias_mesh(x, bias, act, interpret, bn, spmd):
+    c = x.shape[-1]
+    return island(
+        spmd, lambda xl, bl: _epi_bias_2d(
+            xl.reshape(-1, c), bl, act, interpret, bn
+        ).reshape(xl.shape),
+        in_batch=(True, False), out_batch=True)(x, bias)
+
+
+def _epi_bias_mesh_fwd(x, bias, act, interpret, bn, spmd):
+    y = _epi_bias_mesh(x, bias, act, interpret, bn, spmd)
+    return y, (y, bias)
+
+
+def _epi_bias_mesh_bwd(act, interpret, bn, spmd, res, dy):
+    y, bias = res
+    c = y.shape[-1]
+
+    def local(yl, dyl):
+        n = yl.size // c
+        dx2, db = pl.pallas_call(
+            functools.partial(_epi_bwd_kernel, act=act, has_bias=True,
+                              nb=n // bn),
+            grid=(n // bn,),
+            in_specs=[pl.BlockSpec((bn, c), lambda j: (j, 0)),
+                      pl.BlockSpec((bn, c), lambda j: (j, 0))],
+            out_specs=[pl.BlockSpec((bn, c), lambda j: (j, 0)),
+                       pl.BlockSpec((1, c), lambda j: (0, 0))],
+            out_shape=[jax.ShapeDtypeStruct((n, c), yl.dtype),
+                       jax.ShapeDtypeStruct((1, c), jnp.float32)],
+            scratch_shapes=[pltpu.VMEM((1, c), jnp.float32)],
+            interpret=interpret,
+        )(yl.reshape(n, c), dyl.reshape(n, c))
+        db = jax.lax.psum(db, spmd.batch_axis)
+        return dx2.reshape(yl.shape), db
+    dx, db = island(spmd, local, in_batch=(True, True),
+                    out_batch=(True, False))(y, dy)
+    return dx, db.reshape(bias.shape).astype(bias.dtype)
+
+
+_epi_bias_mesh.defvjp(_epi_bias_mesh_fwd, _epi_bias_mesh_bwd)
+
+
 def fused_bias_act(x: jax.Array, bias: Optional[jax.Array],
                    act: str = "none", interpret: Optional[bool] = None,
-                   block_rows: int = 512):
+                   block_rows: int = 512,
+                   spmd: Optional[FusedSpmd] = None):
     """Fused epilogue on an NHWC/flat node's trailing channel axis.
     Returns y (x.dtype) or ``None`` when unsupported / nothing to
-    fuse."""
+    fuse. With ``spmd`` the kernels run as shard_map islands on the
+    mesh (dbias psum'd over the data axis in the backward)."""
     if not HAVE_PALLAS or not supported_dtype(x):
         return None
     if x.ndim != 4 or act not in ("none", "relu"):
@@ -170,12 +228,28 @@ def fused_bias_act(x: jax.Array, bias: Optional[jax.Array],
         return None                      # nothing to fuse
     c = x.shape[-1]
     n = x.size // c
+    if spmd is not None:
+        if not batch_divisible(spmd, x.shape[0]):
+            note_fallback("epilogue_batch_indivisible")
+            return None
+        n_local = n // spmd.n_shards
+    else:
+        n_local = n
     target = max(8, min(block_rows, (1 << 20) // max(4 * c, 1) // 8 * 8))
-    bn = row_block(n, target, mult=sublane_mult(x))
+    bn = row_block(n_local, target, mult=sublane_mult(x))
     if bn is None or (bias is not None and bias.shape != (c,)):
+        if spmd is not None:
+            note_fallback("epilogue_shape")
         return None
-    x2 = x.reshape(n, c)
     itp = use_interpret(interpret)
+    if spmd is not None:
+        if bias is None:
+            return island(
+                spmd, lambda xl: _epi_act_2d(
+                    xl.reshape(-1, c), act, itp, bn).reshape(xl.shape),
+                in_batch=(True,), out_batch=True)(x)
+        return _epi_bias_mesh(x, bias, act, itp, bn, spmd)
+    x2 = x.reshape(n, c)
     if bias is None:
         y = _epi_act_2d(x2, act, itp, bn)
     else:

@@ -31,7 +31,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .fused import (HAVE_PALLAS, row_block, sublane_mult,
+from .fused import (HAVE_PALLAS, FusedSpmd, batch_divisible, island,
+                    note_fallback, row_block, sublane_mult,
                     supported_dtype, use_interpret)
 
 if HAVE_PALLAS:
@@ -132,9 +133,14 @@ _lrn_2d.defvjp(_lrn_fwd, _lrn_bwd)
 
 def fused_lrn(x: jax.Array, nsize: int, alpha: float, beta: float,
               knorm: float, interpret: Optional[bool] = None,
-              block_rows: int = 256):
+              block_rows: int = 256,
+              spmd: Optional[FusedSpmd] = None):
     """Fused LRN over the trailing channel axis of an NHWC node.
-    Returns y (x.dtype) or ``None`` when unsupported."""
+    Returns y (x.dtype) or ``None`` when unsupported. With ``spmd``
+    the kernel runs as a shard_map island over the batch dim — LRN is
+    row-local (the window runs over channels), so the island needs no
+    collectives and its shard_map transpose is exact; the band
+    matrices ride as closed-over constants."""
     if not HAVE_PALLAS or not supported_dtype(x):
         return None
     if x.ndim != 4 or knorm <= 0:
@@ -143,11 +149,26 @@ def fused_lrn(x: jax.Array, nsize: int, alpha: float, beta: float,
     n = x.size // c
     if c > 1024:          # (C, C) band must stay comfortably in VMEM
         return None
+    if spmd is not None:
+        if not batch_divisible(spmd, x.shape[0]):
+            note_fallback("lrn_batch_indivisible")
+            return None
+        n_local = n // spmd.n_shards
+    else:
+        n_local = n
     target = max(8, min(block_rows, (1 << 20) // max(4 * c, 1) // 8 * 8))
-    bn = row_block(n, target, mult=sublane_mult(x))
+    bn = row_block(n_local, target, mult=sublane_mult(x))
     if bn is None:
+        if spmd is not None:
+            note_fallback("lrn_shape")
         return None
     band = jnp.asarray(band_matrix(c, nsize))
-    y = _lrn_2d(x.reshape(n, c), band, band.T, float(alpha) / nsize,
-                float(beta), float(knorm), use_interpret(interpret), bn)
+    args = (band, band.T, float(alpha) / nsize, float(beta),
+            float(knorm), use_interpret(interpret), bn)
+    if spmd is not None:
+        return island(
+            spmd, lambda xl: _lrn_2d(xl.reshape(-1, c),
+                                     *args).reshape(xl.shape),
+            in_batch=(True,), out_batch=True)(x)
+    y = _lrn_2d(x.reshape(n, c), *args)
     return y.reshape(x.shape)

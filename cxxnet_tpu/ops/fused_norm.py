@@ -42,7 +42,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from .fused import (HAVE_PALLAS, row_block, sublane_mult,
+from .fused import (HAVE_PALLAS, FusedSpmd, batch_divisible, island,
+                    note_fallback, row_block, sublane_mult,
                     supported_dtype, use_interpret)
 
 if HAVE_PALLAS:
@@ -268,26 +269,259 @@ def _bn_act_bwd(eps, act, two_pass, interpret, bn, res, cts):
 _bn_act_2d.defvjp(_bn_act_fwd, _bn_act_bwd)
 
 
+# -- mesh (shard_map island) variant ------------------------------------------
+#
+# On a dp mesh the single fused kernel cannot stand: its moments would
+# be shard-local where the jnp path's jnp.mean is a cross-replica
+# sync-BN collective, and GSPMD cannot shard the opaque pallas_call
+# anyway. The mesh variant splits the moment pass from the normalize
+# pass around an explicit psum over the data axis, all inside one
+# fully-manual shard_map island: per shard the HBM traffic is still
+# two streaming reads of x plus one write of y (the single-device
+# minimum), and the psum'd sums make fused BN on a dp mesh match the
+# global-moment jnp reference bit-for-bit in fp32 whenever the sums
+# themselves are exact (integer-valued activations; pinned by
+# tests/test_fused_mesh.py) and to f32 rounding otherwise. The
+# backward's cross-shard reductions (dgamma/dbeta and the dx formula's
+# sum terms) psum the same way. custom_vjp sits OUTSIDE the islands —
+# fwd and bwd are each their own shard_map — so autodiff never
+# transposes a shard_map (whose 0.4.x transpose rules the psum'd
+# replicated outputs would confuse).
+
+def _bn_sums_kernel(x_ref, s1_ref, s2_ref, acc1, acc2, *, nb):
+    """One streaming read: per-channel local (sum, sum of squares)."""
+    j = pl.program_id(0)
+
+    @pl.when(j == 0)
+    def _init():
+        acc1[...] = jnp.zeros_like(acc1)
+        acc2[...] = jnp.zeros_like(acc2)
+    xb = x_ref[...].astype(jnp.float32)
+    acc1[...] += jnp.sum(xb, axis=0, keepdims=True)
+    acc2[...] += jnp.sum(xb * xb, axis=0, keepdims=True)
+
+    @pl.when(j == nb - 1)
+    def _finish():
+        s1_ref[...] = acc1[...]
+        s2_ref[...] = acc2[...]
+
+
+def _bn_norm_kernel(x_ref, gamma_ref, beta_ref, mean_ref, rstd_ref,
+                    y_ref, *, act):
+    """Second read + the write: normalize/scale/shift (+relu) with the
+    (already global) mean/rstd handed in as (1, C) rows."""
+    xb = x_ref[...].astype(jnp.float32)
+    out = ((xb - mean_ref[...]) * rstd_ref[...]
+           * gamma_ref[...].astype(jnp.float32)
+           + beta_ref[...].astype(jnp.float32))
+    if act == "relu":
+        out = jnp.maximum(out, 0.0)
+    y_ref[...] = out.astype(y_ref.dtype)
+
+
+def _bn_bwd_sums_kernel(*refs, nb, act):
+    """Local backward reductions: per-channel sum(dy') and
+    sum(dy'*x_hat), dy' masked by the activation."""
+    if act == "relu":
+        x_ref, dy_ref, y_ref, mean_ref, rstd_ref, sb_ref, sxh_ref, \
+            ab, axh = refs
+    else:
+        x_ref, dy_ref, mean_ref, rstd_ref, sb_ref, sxh_ref, ab, axh = refs
+        y_ref = None
+    j = pl.program_id(0)
+
+    @pl.when(j == 0)
+    def _init():
+        ab[...] = jnp.zeros_like(ab)
+        axh[...] = jnp.zeros_like(axh)
+    dyb = dy_ref[...].astype(jnp.float32)
+    if y_ref is not None:
+        dyb = jnp.where(y_ref[...].astype(jnp.float32) > 0.0, dyb, 0.0)
+    xh = (x_ref[...].astype(jnp.float32) - mean_ref[...]) * rstd_ref[...]
+    ab[...] += jnp.sum(dyb, axis=0, keepdims=True)
+    axh[...] += jnp.sum(dyb * xh, axis=0, keepdims=True)
+
+    @pl.when(j == nb - 1)
+    def _finish():
+        sb_ref[...] = ab[...]
+        sxh_ref[...] = axh[...]
+
+
+def _bn_bwd_dx_kernel(*refs, act):
+    """dx from the fused formula, with the mean-normalized GLOBAL
+    reduction terms (sb/n, sxh/n) handed in as (1, C) rows."""
+    if act == "relu":
+        (x_ref, dy_ref, y_ref, gamma_ref, mean_ref, rstd_ref,
+         sbn_ref, sxhn_ref, dx_ref) = refs
+    else:
+        (x_ref, dy_ref, gamma_ref, mean_ref, rstd_ref,
+         sbn_ref, sxhn_ref, dx_ref) = refs
+        y_ref = None
+    dyb = dy_ref[...].astype(jnp.float32)
+    if y_ref is not None:
+        dyb = jnp.where(y_ref[...].astype(jnp.float32) > 0.0, dyb, 0.0)
+    xh = (x_ref[...].astype(jnp.float32) - mean_ref[...]) * rstd_ref[...]
+    g = gamma_ref[...].astype(jnp.float32) * rstd_ref[...]
+    dx = g * (dyb - sbn_ref[...] - xh * sxhn_ref[...])
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+
+
+def _row_vec_specs(bn, c):
+    return (pl.BlockSpec((bn, c), lambda j: (j, 0)),
+            pl.BlockSpec((1, c), lambda j: (0, 0)))
+
+
+def _mesh_fwd_local(x, gamma, beta, *, c, eps, act, interpret, bn, axis,
+                    n_total):
+    """Island body (local shard): pallas sums -> psum -> global
+    moments -> pallas normalize."""
+    x2 = x.reshape(-1, c)
+    n, _ = x2.shape
+    nb = n // bn
+    row, vec = _row_vec_specs(bn, c)
+    s1, s2 = pl.pallas_call(
+        functools.partial(_bn_sums_kernel, nb=nb),
+        grid=(nb,), in_specs=[row], out_specs=[vec, vec],
+        out_shape=[jax.ShapeDtypeStruct((1, c), jnp.float32)] * 2,
+        scratch_shapes=[pltpu.VMEM((1, c), jnp.float32)] * 2,
+        interpret=interpret)(x2)
+    s1 = jax.lax.psum(s1, axis)
+    s2 = jax.lax.psum(s2, axis)
+    mean = s1 / n_total
+    # one-pass E[x^2]-E[x]^2 with the same clamp as the jnp reference
+    var = jnp.maximum(s2 / n_total - mean * mean, 0.0)
+    rstd = jax.lax.rsqrt(var + eps)
+    y2 = pl.pallas_call(
+        functools.partial(_bn_norm_kernel, act=act),
+        grid=(nb,), in_specs=[row, vec, vec, vec, vec], out_specs=row,
+        out_shape=jax.ShapeDtypeStruct(x2.shape, x2.dtype),
+        interpret=interpret)(x2, gamma.reshape(1, c),
+                             beta.reshape(1, c), mean, rstd)
+    return (y2.reshape(x.shape), mean.reshape(c), var.reshape(c),
+            rstd.reshape(c))
+
+
+def _mesh_bwd_local(x, dy, y, gamma, mean, rstd, *, c, act, interpret,
+                    bn, axis, n_total):
+    """Island body (local shard): pallas reductions -> psum -> pallas
+    dx; dgamma/dbeta are the psum'd (global) reductions."""
+    x2 = x.reshape(-1, c)
+    dy2 = dy.reshape(-1, c)
+    n, _ = x2.shape
+    nb = n // bn
+    row, vec = _row_vec_specs(bn, c)
+    mean_r, rstd_r = mean.reshape(1, c), rstd.reshape(1, c)
+    ins = [x2, dy2] + ([y.reshape(-1, c)] if act == "relu" else []) \
+        + [mean_r, rstd_r]
+    in_specs = [row, row] + ([row] if act == "relu" else []) + [vec, vec]
+    sb, sxh = pl.pallas_call(
+        functools.partial(_bn_bwd_sums_kernel, nb=nb, act=act),
+        grid=(nb,), in_specs=in_specs, out_specs=[vec, vec],
+        out_shape=[jax.ShapeDtypeStruct((1, c), jnp.float32)] * 2,
+        scratch_shapes=[pltpu.VMEM((1, c), jnp.float32)] * 2,
+        interpret=interpret)(*ins)
+    sb = jax.lax.psum(sb, axis)
+    sxh = jax.lax.psum(sxh, axis)
+    ins2 = [x2, dy2] + ([y.reshape(-1, c)] if act == "relu" else []) \
+        + [gamma.reshape(1, c), mean_r, rstd_r, sb / n_total,
+           sxh / n_total]
+    in_specs2 = [row, row] + ([row] if act == "relu" else []) \
+        + [vec] * 5
+    dx2 = pl.pallas_call(
+        functools.partial(_bn_bwd_dx_kernel, act=act),
+        grid=(nb,), in_specs=in_specs2, out_specs=row,
+        out_shape=jax.ShapeDtypeStruct(x2.shape, x2.dtype),
+        interpret=interpret)(*ins2)
+    return dx2.reshape(x.shape), sxh.reshape(c), sb.reshape(c)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _bn_act_mesh(x, gamma, beta, eps, act, interpret, bn, spmd, n_total):
+    y, mean, var, _ = island(
+        spmd, functools.partial(
+            _mesh_fwd_local, c=x.shape[-1], eps=eps, act=act,
+            interpret=interpret, bn=bn, axis=spmd.batch_axis,
+            n_total=n_total),
+        in_batch=(True, False, False),
+        out_batch=(True, False, False, False))(x, gamma, beta)
+    return y, mean, var
+
+
+def _bn_act_mesh_fwd(x, gamma, beta, eps, act, interpret, bn, spmd,
+                     n_total):
+    y, mean, var, rstd = island(
+        spmd, functools.partial(
+            _mesh_fwd_local, c=x.shape[-1], eps=eps, act=act,
+            interpret=interpret, bn=bn, axis=spmd.batch_axis,
+            n_total=n_total),
+        in_batch=(True, False, False),
+        out_batch=(True, False, False, False))(x, gamma, beta)
+    res = (x, gamma, mean, rstd, y if act == "relu" else None)
+    return (y, mean, var), res
+
+
+def _bn_act_mesh_bwd(eps, act, interpret, bn, spmd, n_total, res, cts):
+    # mean/var cotangents are structurally zero (EMA-only outputs),
+    # exactly as on the single-device path
+    x, gamma, mean, rstd, y = res
+    dy = cts[0]
+    if y is None:
+        y = dy          # placeholder with the right sharding; unread
+    dx, dgamma, dbeta = island(
+        spmd, functools.partial(
+            _mesh_bwd_local, c=x.shape[-1], act=act, interpret=interpret,
+            bn=bn, axis=spmd.batch_axis, n_total=n_total),
+        in_batch=(True, True, True, False, False, False),
+        out_batch=(True, False, False))(x, dy, y, gamma, mean, rstd)
+    return (dx, dgamma.reshape(gamma.shape).astype(gamma.dtype),
+            dbeta.reshape(gamma.shape).astype(gamma.dtype))
+
+
+_bn_act_mesh.defvjp(_bn_act_mesh_fwd, _bn_act_mesh_bwd)
+
+
 def fused_bn_act(x: jax.Array, gamma: jax.Array, beta: jax.Array,
                  eps: float, act: str = "none", two_pass: bool = False,
                  interpret: Optional[bool] = None,
-                 block_rows: int = 256):
+                 block_rows: int = 256,
+                 spmd: Optional[FusedSpmd] = None):
     """Fused train-time batch norm (+ optional relu) over the trailing
     channel axis of an NHWC or flat node. Returns ``(y, mean, var)``
     with y in x.dtype and f32 stats, or ``None`` when unsupported
-    (caller falls back to the jnp reference)."""
+    (caller falls back to the jnp reference). With ``spmd`` the op
+    runs as a shard_map island on the mesh — moments are psum'd over
+    the data axis (sync-BN) so the math matches the GSPMD jnp path."""
     if not HAVE_PALLAS or not supported_dtype(x):
         return None
     if x.ndim != 4 or act not in ("none", "relu"):
         return None
     c = x.shape[-1]
     n = x.size // c
+    if spmd is not None:
+        if two_pass:
+            # the mesh islands implement the default one-pass moments
+            # only; bn_two_pass falls back to the (sync-BN) jnp path
+            note_fallback("bn_two_pass_mesh")
+            return None
+        if not batch_divisible(spmd, x.shape[0]):
+            note_fallback("bn_batch_indivisible")
+            return None
+        n_local = n // spmd.n_shards
+    else:
+        n_local = n
     # keep ~2 row blocks + accumulators comfortably inside VMEM even
     # for wide flat nodes: shrink the row tile as C grows
     target = max(8, min(block_rows, (1 << 20) // max(4 * c, 1) // 8 * 8))
-    bn = row_block(n, target, mult=sublane_mult(x))
+    bn = row_block(n_local, target, mult=sublane_mult(x))
     if bn is None or gamma.shape != (c,) or beta.shape != (c,):
+        if spmd is not None:
+            note_fallback("bn_shape")
         return None
+    if spmd is not None:
+        y, mean, var = _bn_act_mesh(x, gamma, beta, float(eps), act,
+                                    use_interpret(interpret), bn, spmd,
+                                    float(n))
+        return y, mean, var
     x2 = x.reshape(n, c)
     y, mean, var = _bn_act_2d(x2, gamma, beta, float(eps), act,
                               bool(two_pass), use_interpret(interpret), bn)

@@ -40,7 +40,7 @@ from typing import List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-from .fused import HAVE_PALLAS, use_interpret
+from .fused import HAVE_PALLAS, FusedSpmd, island, use_interpret
 
 if HAVE_PALLAS:
     from jax.experimental import pallas as pl
@@ -130,10 +130,25 @@ def fused_sgd_apply(ws: List[jax.Array], gs: List[jax.Array],
                     ms: List[jax.Array], lr, momentum, *,
                     wd: float, clip: float, nag: bool,
                     interpret: Optional[bool] = None,
-                    block_rows: int = 256
+                    block_rows: int = 256,
+                    spmd: Optional[FusedSpmd] = None
                     ) -> Tuple[List[jax.Array], List[jax.Array]]:
     """One fused SGD/NAG momentum step over a whole tag group's leaves.
-    Returns (new_ws, new_ms) with the input shapes/dtypes."""
+    Returns (new_ws, new_ms) with the input shapes/dtypes. With
+    ``spmd`` the whole pack->kernel->unpack runs as a fully-replicated
+    shard_map island: masters/grads/momenta are replicated on a dp
+    mesh, every device computes the identical update, and GSPMD never
+    meets the opaque pallas_call."""
+    if spmd is not None:
+        # lr/momentum may be traced schedule scalars: explicit island
+        # inputs (replicated), never closure captures
+        return island(
+            spmd, lambda w_, g_, m_, lr_, mom_: fused_sgd_apply(
+                w_, g_, m_, lr_, mom_, wd=wd, clip=clip, nag=nag,
+                interpret=interpret, block_rows=block_rows),
+            in_batch=(False,) * 5, out_batch=(False, False)
+        )(list(ws), list(gs), list(ms), jnp.asarray(lr, jnp.float32),
+          jnp.asarray(momentum, jnp.float32))
     shapes = [w.shape for w in ws]
     dtypes = [w.dtype for w in ws]
     wm, total = _pack(ws, block_rows)
@@ -153,9 +168,19 @@ def fused_adam_apply(ws: List[jax.Array], gs: List[jax.Array],
                      m1s: List[jax.Array], m2s: List[jax.Array], lr_t, *,
                      wd: float, clip: float, d1: float, d2: float,
                      interpret: Optional[bool] = None,
-                     block_rows: int = 256):
+                     block_rows: int = 256,
+                     spmd: Optional[FusedSpmd] = None):
     """One fused Adam step over a tag group (``lr_t`` already carries
-    the bias correction). Returns (new_ws, new_m1s, new_m2s)."""
+    the bias correction). Returns (new_ws, new_m1s, new_m2s). With
+    ``spmd``: fully-replicated shard_map island (see fused_sgd_apply)."""
+    if spmd is not None:
+        return island(
+            spmd, lambda w_, g_, a_, b_, lr_: fused_adam_apply(
+                w_, g_, a_, b_, lr_, wd=wd, clip=clip, d1=d1, d2=d2,
+                interpret=interpret, block_rows=block_rows),
+            in_batch=(False,) * 5, out_batch=(False, False, False)
+        )(list(ws), list(gs), list(m1s), list(m2s),
+          jnp.asarray(lr_t, jnp.float32))
     shapes = [w.shape for w in ws]
     dtypes = [w.dtype for w in ws]
     wm, total = _pack(ws, block_rows)

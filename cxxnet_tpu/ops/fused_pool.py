@@ -40,7 +40,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from .fused import (HAVE_PALLAS, row_block, sublane_mult,
+from .fused import (HAVE_PALLAS, FusedSpmd, batch_divisible, island,
+                    note_fallback, row_block, sublane_mult,
                     supported_dtype, use_interpret)
 
 if HAVE_PALLAS:
@@ -191,7 +192,8 @@ def fused_pool(x: jax.Array, kh: int, kw: int, stride: int,
                pad: Tuple[int, int], extra: Tuple[int, int],
                reducer: str, scale_avg: bool, pre_relu: bool,
                interpret: Optional[bool] = None,
-               block_rows: int = 64) -> Optional[jax.Array]:
+               block_rows: int = 64,
+               spmd: Optional[FusedSpmd] = None) -> Optional[jax.Array]:
     """Fused pooling over an NHWC node, or ``None`` when the geometry
     is unsupported (caller runs its reduce_window reference):
     pad/extra must be 0 and windows must either tile exactly
@@ -213,14 +215,32 @@ def fused_pool(x: jax.Array, kh: int, kw: int, stride: int,
     oy, ox = h // kh if kh != h else 1, w // kw if kw != w else 1
     scale = 1.0 / (kh * kw) if scale_avg else 1.0
     n = b * oy
+    if spmd is not None:
+        if not batch_divisible(spmd, b):
+            note_fallback("pool_batch_indivisible")
+            return None
+        n_local = n // spmd.n_shards
+    else:
+        n_local = n
     # VMEM budget: one (rb, kh, ox, kw, C) block + its output
     per_row = kh * ox * kw * c * max(x.dtype.itemsize, 2)
     target = max(8, min(block_rows, (1 << 20) // max(per_row, 1)
                         // 8 * 8))
-    rb = row_block(n, target, mult=sublane_mult(x))
+    rb = row_block(n_local, target, mult=sublane_mult(x))
     if rb is None:
+        if spmd is not None:
+            note_fallback("pool_shape")
         return None
+    itp = use_interpret(interpret)
+    if spmd is not None:
+        # pooling is row-local (windows never cross the batch dim):
+        # collective-free island, exact shard_map transpose
+        return island(
+            spmd, lambda xl: _pool5(
+                xl.reshape(-1, kh, ox, kw, c), reducer, pre_relu,
+                float(scale), itp, rb
+            ).reshape(xl.shape[0], oy, ox, c),
+            in_batch=(True,), out_batch=True)(x)
     xr = x.reshape(n, kh, ox, kw, c)
-    y = _pool5(xr, reducer, pre_relu, float(scale),
-               use_interpret(interpret), rb)
+    y = _pool5(xr, reducer, pre_relu, float(scale), itp, rb)
     return y.reshape(b, oy, ox, c)

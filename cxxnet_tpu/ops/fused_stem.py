@@ -41,7 +41,8 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
-from .fused import HAVE_PALLAS, row_block, use_interpret
+from .fused import (HAVE_PALLAS, FusedSpmd, batch_divisible, island,
+                    note_fallback, row_block, use_interpret)
 
 if HAVE_PALLAS:
     from jax.experimental import pallas as pl
@@ -110,23 +111,34 @@ def _col_block(cols: int, target: int = 2048, mult: int = 128
 
 def fused_decode_normalize(x: jax.Array, mean: Optional[jax.Array],
                            factor, out_dtype: Any,
-                           interpret: Optional[bool] = None
+                           interpret: Optional[bool] = None,
+                           spmd: Optional[FusedSpmd] = None
                            ) -> Optional[jax.Array]:
     """One streaming Pallas pass: uint8 NHWC batch -> normalized
     compute-dtype batch. ``mean`` is None, per-channel (C,), or a mean
     image (H, W, C); ``factor`` a scalar (python or traced). Returns
     None when the shape is unsupported (caller uses the jnp
-    reference)."""
+    reference). With ``spmd`` the pass runs as a shard_map island over
+    the batch dim (pure data path — no collectives, no vjp)."""
     if not HAVE_PALLAS or x.dtype != jnp.uint8 or x.ndim != 4:
         return None
     b, h, w, c = x.shape
     cols = h * w * c
+    b_local = b
+    if spmd is not None:
+        if not batch_divisible(spmd, b):
+            note_fallback("stem_batch_indivisible")
+            return None
+        b_local = b // spmd.n_shards
     # batch rows: uint8 tiles pack (32, 128); accept the f32 sublane (8)
     # as a fallback so small CPU-test batches still exercise the kernel
     # in interpret mode
-    rb = row_block(b, 128, mult=32) or row_block(b, 128, mult=8)
+    rb = row_block(b_local, 128, mult=32) or row_block(b_local, 128,
+                                                       mult=8)
     cb = _col_block(cols)
     if rb is None or cb is None:
+        if spmd is not None:
+            note_fallback("stem_shape")
         return None
     if mean is not None:
         mean = jnp.asarray(mean, jnp.float32)
@@ -142,19 +154,37 @@ def fused_decode_normalize(x: jax.Array, mean: Optional[jax.Array],
     else:
         mean_row = None
     factor = jnp.asarray(factor, jnp.float32)
+    itp = use_interpret(interpret)
+    if spmd is not None:
+        # mean_row/factor may be traced step arguments — explicit
+        # island inputs (replicated), never closure captures
+        if mean_row is not None:
+            def local(xl, mr, f):
+                y2l = _stem_call(xl.reshape(-1, cols), mr,
+                                 f, jnp.dtype(out_dtype), itp, rb, cb)
+                return y2l.reshape(xl.shape)
+            return island(spmd, local, in_batch=(True, False, False),
+                          out_batch=True)(x, mean_row, factor)
+
+        def local(xl, f):
+            y2l = _stem_call(xl.reshape(-1, cols), None, f,
+                             jnp.dtype(out_dtype), itp, rb, cb)
+            return y2l.reshape(xl.shape)
+        return island(spmd, local, in_batch=(True, False),
+                      out_batch=True)(x, factor)
     y2 = _stem_call(x.reshape(b, cols), mean_row, factor,
-                    jnp.dtype(out_dtype), use_interpret(interpret),
-                    rb, cb)
+                    jnp.dtype(out_dtype), itp, rb, cb)
     return y2.reshape(b, h, w, c)
 
 
 def decode_normalize(x: jax.Array, mean: Optional[jax.Array], factor,
-                     out_dtype: Any, fused: bool = False) -> jax.Array:
+                     out_dtype: Any, fused: bool = False,
+                     spmd: Optional[FusedSpmd] = None) -> jax.Array:
     """Dispatcher the trainer's folded step calls: the Pallas kernel
     when the fused suite is active (and the shape qualifies), else the
     jnp reference — both inside the compiled train step."""
     if fused:
-        y = fused_decode_normalize(x, mean, factor, out_dtype)
+        y = fused_decode_normalize(x, mean, factor, out_dtype, spmd=spmd)
         if y is not None:
             return y
     return decode_normalize_reference(x, mean, factor, out_dtype)

@@ -195,13 +195,16 @@ class Optimizer:
         # fused multi-tensor apply (ops/fused_optim.py): one streaming
         # Pallas pass per tag group instead of N per-leaf elementwise
         # chains. Same knob as the layer kernels (fused_kernels =
-        # auto|1|0, env CXXNET_FUSED_KERNELS); the trainer clears
-        # fused_ok on multi-device meshes (sharded opt state cannot
-        # flow through an opaque custom call).
+        # auto|1|0, env CXXNET_FUSED_KERNELS). On a replicated-master
+        # dp mesh the trainer binds ``fused_spmd`` and the apply runs
+        # as a fully-replicated shard_map island; with SHARDED masters
+        # (tp / fsdp) it clears fused_ok instead (counted in
+        # cxxnet_fused_fallback_total).
         from .ops.fused import resolve_mode
         self.fused_mode = resolve_mode(
             global_param(cfg, "fused_kernels", "auto"))
         self.fused_ok = True
+        self.fused_spmd = None
         self.ls_init = float(global_param(cfg, "loss_scale_init",
                                           str(2.0 ** 15)))
         self.ls_window = int(global_param(cfg, "loss_scale_window", "200"))
@@ -369,7 +372,8 @@ class Optimizer:
                 ws, nm1s, nm2s = fused_adam_apply(
                     [wl[i] for i in idxs], [gl[i] for i in idxs],
                     [m1l[i] for i in idxs], [m2l[i] for i in idxs],
-                    lr_t, wd=h.wd, clip=h.clip_gradient, d1=d1, d2=d2)
+                    lr_t, wd=h.wd, clip=h.clip_gradient, d1=d1, d2=d2,
+                    spmd=self.fused_spmd)
                 for i, w_, a_, b_ in zip(idxs, ws, nm1s, nm2s):
                     nw[i], nm1[i], nm2[i] = w_, a_, b_
             unflat = lambda ls: jax.tree_util.tree_unflatten(treedef, ls)
@@ -384,7 +388,8 @@ class Optimizer:
             ws, ms = fused_sgd_apply(
                 [wl[i] for i in idxs], [gl[i] for i in idxs],
                 [ml[i] for i in idxs], lr, momentum,
-                wd=h.wd, clip=h.clip_gradient, nag=self.type == "nag")
+                wd=h.wd, clip=h.clip_gradient, nag=self.type == "nag",
+                spmd=self.fused_spmd)
             for i, w_, m_ in zip(idxs, ws, ms):
                 nw[i], nm[i] = w_, m_
         unflat = lambda ls: jax.tree_util.tree_unflatten(treedef, ls)

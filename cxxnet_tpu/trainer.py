@@ -66,7 +66,8 @@ def _fold_input(data, net):
     x, mean, factor = data
     from .ops.fused_stem import decode_normalize
     return decode_normalize(x, mean, factor, net.compute_dtype,
-                            fused=net._fused_now())
+                            fused=net._fused_now(),
+                            spmd=net.fused_spmd)
 
 
 def _chain_scan(one, length):
@@ -180,15 +181,70 @@ class Trainer:
         self._pp_microbatch = int(gp("pipeline_microbatch",
                                      str(max(self._pp, 1))))
         self.optimizer = create_optimizer(self.graph.updater_type, cfg)
-        # fused Pallas kernels are single-device only: a pallas_call is
-        # an opaque custom call the GSPMD partitioner cannot shard, and
-        # the fused BN's moments would be shard-local where the jnp
-        # path's jnp.mean is a cross-replica sync-BN collective. The
-        # manual shard_map paths (sp/pp) never set ctx.fused, but the
-        # std GSPMD step does — gate it here.
-        if (self.mesh.num_devices > 1 or self._sp > 1 or self._pp > 1):
+        # rule-driven sharding namespace (validated in Network.__init__)
+        self.sharding_cfg = self.net.sharding_cfg
+        self._fsdp_axis = self.sharding_cfg.fsdp_axis
+        if self._fsdp_axis and (self._sp > 1 or self._pp > 1):
+            raise ValueError(
+                "fsdp_axis composes with the std (GSPMD dp/tp) step "
+                "only; the pp step has its own at-rest FSDP over "
+                "'pipe' and sp keeps params replicated")
+        # fused Pallas kernels x meshes: a bare pallas_call is an
+        # opaque custom call the GSPMD partitioner cannot shard, so on
+        # a dp (or dp x tp) mesh the fused ops run as fully-manual
+        # shard_map islands (ops.fused.FusedSpmd; sync-BN as a psum
+        # over the data axis inside the fused moment pass) and the
+        # gate stays OPEN. Topologies the islands do not cover clear
+        # the gate as before — but loudly: one-time warning plus the
+        # cxxnet_fused_fallback_total{reason} counter, so a mesh run
+        # that still falls back is visible in /metrics and the ledger.
+        from .ops.fused import FusedSpmd, kernels_active, note_fallback
+        # warn/count only when the kernels WOULD have run (knob x env x
+        # backend) — an auto-on-CPU run loses nothing and should not
+        # spam the fallback counter
+        would_fuse = kernels_active(self.net.fused_mode)
+        if self._pp > 1:
             self.net.fused_single_device = False
             self.optimizer.fused_ok = False
+            if would_fuse:
+                note_fallback(
+                    "pipeline_parallel",
+                    warn="reference path on this pp mesh (fused kernels "
+                         "do not run inside the pipeline's lax.switch "
+                         "stage schedule)")
+        elif self._sp > 1:
+            # the sp step body is already a manual shard_map: bare
+            # pallas_calls are legal there (no island needed), and no
+            # sp-safe layer uses the BN/LRN/epilogue kernels anyway —
+            # only the fused optimizer fires. sp x tp keeps 'model'
+            # AUTOMATIC inside the body, where a pallas_call would
+            # again be GSPMD-opaque: clear the gate there.
+            if self.mesh.model_parallel > 1:
+                self.net.fused_single_device = False
+                self.optimizer.fused_ok = False
+                if would_fuse:
+                    note_fallback(
+                        "seq_x_model",
+                        warn="reference path on this sp x tp mesh (the "
+                             "'model' axis stays automatic inside the "
+                             "sp shard_map)")
+        elif self.mesh.num_devices > 1:
+            self.net.fused_spmd = FusedSpmd(
+                mesh=self.mesh.mesh, batch_axis=self.mesh.data_axis)
+            if self.mesh.model_parallel > 1 or self._fsdp_axis:
+                # model-sharded / FSDP masters cannot flow through the
+                # fully-replicated optimizer island; the layer kernels
+                # keep their islands, only the optimizer falls back
+                self.optimizer.fused_ok = False
+                if would_fuse:
+                    note_fallback(
+                        "sharded_optimizer_state",
+                        warn="per-leaf optimizer on this mesh (masters/"
+                             "optimizer state are sharded; the fused "
+                             "multi-tensor island needs them "
+                             "replicated) — layer kernels stay fused")
+            else:
+                self.optimizer.fused_spmd = self.net.fused_spmd
         # metric bindings (reference nnet_impl-inl.hpp:73-83)
         self.metric = MetricSet()
         self.train_metric = MetricSet()
@@ -348,7 +404,18 @@ class Trainer:
         if self._pp > 1:
             return (self._pp_fsdp_specs(params)
                     if params is not None else {})
-        return self.net.param_pspecs()
+        pspecs = self.net.param_pspecs()
+        if self._fsdp_axis:
+            # FSDP-style at-rest sharding over a config-named axis
+            # (rule-driven; ROADMAP item 4's reshard lever): each
+            # large leaf takes the axis on its first free dividing
+            # dim, GSPMD gathers in-step. Composes with tp specs.
+            from .parallel.rules import add_fsdp
+            pspecs = add_fsdp(
+                pspecs, self.net.param_shapes(), self._fsdp_axis,
+                int(self.mesh.mesh.shape.get(self._fsdp_axis, 1)),
+                self.sharding_cfg.fsdp_min_size)
+        return pspecs
 
     def _pp_fsdp_specs(self, params):
         """Per-leaf PartitionSpec tree: 'pipe' on the first dim divisible

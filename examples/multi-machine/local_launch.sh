@@ -5,6 +5,16 @@
 # CPU devices, joined through jax.distributed into one data-parallel mesh.
 #
 # Usage: sh local_launch.sh [nproc] [config] [extra key=value ...]
+#
+# Weights ACROSS processes (ISSUE 9c / MULTICHIP r06): give each
+# process ONE device and put the model axis across the process
+# boundary — every rule-driven P(...,'model') weight shard then lives
+# on a different host and the step's activation gathers cross DCN:
+#   CXXNET_CPU_DEVICES=1 sh local_launch.sh 2 ../synthetic_mlp.conf \
+#       model_parallel=2
+# (train-error must match the single-process unsharded run; the
+# capture env needs a jaxlib whose CPU backend supports cross-process
+# computations — see __graft_entry__._dryrun_multihost.)
 set -e
 cd "$(dirname "$0")"
 NPROC=${1:-2}

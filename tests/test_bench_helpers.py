@@ -109,3 +109,36 @@ def test_full_flag_exists():
                             "bench.py")).read()
     assert "--full" in src
     assert '"skipped": skip_marker or "budget"' in src
+
+
+def test_dp_mesh_bench_parses_with_post_gate_fused_tag(monkeypatch):
+    """ROADMAP 5(a) follow-through: a budgeted compute_bench on the dp
+    mesh (the 8-CPU-device test default) lands a record that (a) JSON
+    round-trips (the driver's ``parsed != null``) and (b) carries the
+    ACTUAL post-gate fused selection, not the requested knob — the env
+    escape hatch flips the tag with the knob still requesting 1."""
+    import json
+
+    monkeypatch.delenv("CXXNET_FUSED_KERNELS", raising=False)
+    tr = bench.make_trainer(0.25, 64, 8, 8, "cpu",
+                            overrides=(("fused_kernels", "1"),))
+    assert tr.mesh.num_devices > 1          # genuinely a dp mesh
+    c = bench.compute_bench(tr, 64, 8, 8, 2)
+    parsed = json.loads(json.dumps(
+        {k: c[k] for k in ("ips", "per_step_ms", "hbm_bytes_per_step",
+                           "fused_kernels", "fused_on_mesh",
+                           "n_chips")}))
+    assert parsed is not None
+    assert parsed["n_chips"] > 1
+    # post-gate: the dp mesh keeps the fused islands ON
+    assert parsed["fused_kernels"] is True
+    assert parsed["fused_on_mesh"] is True
+    # requested knob still 1, but the env kill switch gates it off: the
+    # tag must follow the ACTUAL selection
+    monkeypatch.setenv("CXXNET_FUSED_KERNELS", "0")
+    tr2 = bench.make_trainer(0.25, 64, 8, 8, "cpu",
+                             overrides=(("fused_kernels", "1"),))
+    assert tr2.net.fused_mode == "on"       # the requested knob
+    c2 = bench.compute_bench(tr2, 64, 8, 8, 2)
+    assert c2["fused_kernels"] is False
+    assert c2["fused_on_mesh"] is False

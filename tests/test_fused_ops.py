@@ -298,16 +298,19 @@ def test_env_escape_hatch(monkeypatch):
     assert "pallas_call" not in _train_jaxpr(_trainer("fused_kernels = 1\n"))
 
 
-def test_multi_device_mesh_gates_fused_off():
-    """Pallas custom calls cannot be GSPMD-partitioned: a data-parallel
-    mesh (the 8-CPU-device test default) must force the reference path
-    even with the knob on."""
+def test_multi_device_mesh_keeps_fused_on():
+    """Fused x mesh (ISSUE 9): a data-parallel mesh (the 8-CPU-device
+    test default) no longer clears the fused gate — the kernels run as
+    shard_map islands, so the traced step carries pallas_calls UNDER
+    shard_map instead of silently taking the reference path."""
     cfg = CONV_CFG.replace("dev = cpu:0-0", "dev = cpu")
     tr = Trainer(parse_config_string(cfg + "fused_kernels = 1\n"))
     tr.init_model()
-    assert not tr.net._fused_now()
-    assert not tr.optimizer._fused_active()
-    assert "pallas_call" not in _train_jaxpr(tr)
+    assert tr.net._fused_now()
+    assert tr.net.fused_spmd is not None
+    assert tr.optimizer._fused_active()
+    jx = _train_jaxpr(tr)
+    assert "pallas_call" in jx and "shard_map" in jx
 
 
 @pytest.mark.parametrize("updater,extra",
