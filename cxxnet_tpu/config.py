@@ -387,6 +387,73 @@ def parse_sharding_config(cfg: ConfigPairs) -> ShardingConfig:
     return sc
 
 
+# -- elastic training ---------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ElasticConfig:
+    """The ``elastic_*`` knob set (doc/tasks.md "Elastic training").
+    One validated namespace, same contract as ``serve_*`` /
+    ``telemetry_*``: a typo'd key raises instead of silently running a
+    non-elastic (or wrongly-tuned) job. ``elastic_dir`` set = the train
+    task runs as an elastic worker (membership + topology-change resume
+    + preemption grace); unset = everything below is inert."""
+    dir: str = ""                 # elastic_dir: shared membership dir
+    heartbeat_s: float = 5.0      # elastic_heartbeat_s: liveness cadence
+    grace_s: float = 10.0         # elastic_grace_s: SIGTERM notice window
+    min_workers: int = 1          # elastic_min_workers: train floor
+    worker: int = -1              # elastic_worker: -1 = telemetry host id
+    capacity: int = 0             # elastic_capacity: dp this worker can
+    #                               host (0 = its local device count)
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.dir)
+
+
+def parse_elastic_config(cfg: ConfigPairs) -> ElasticConfig:
+    """Collect/validate the ``elastic_*`` keys (last occurrence wins;
+    unknown keys in the namespace fail fast)."""
+    known = {
+        "elastic_dir": ("dir", str),
+        "elastic_heartbeat_s": ("heartbeat_s", float),
+        "elastic_grace_s": ("grace_s", float),
+        "elastic_min_workers": ("min_workers", int),
+        "elastic_worker": ("worker", int),
+        "elastic_capacity": ("capacity", int),
+    }
+    vals = {}
+    for name, val in cfg:
+        if name.startswith("elastic_"):
+            if name not in known:
+                raise ConfigError(
+                    f"unknown elastic setting {name!r}; valid keys: "
+                    + ", ".join(sorted(known)))
+            field, conv = known[name]
+            try:
+                vals[field] = conv(val)
+            except ValueError as e:
+                raise ConfigError(f"bad {name} value {val!r}: {e}")
+    ec = ElasticConfig(**vals)
+    if ec.heartbeat_s <= 0:
+        raise ConfigError(
+            f"elastic_heartbeat_s must be > 0, got {ec.heartbeat_s}")
+    if ec.grace_s < 0:
+        raise ConfigError(
+            f"elastic_grace_s must be >= 0, got {ec.grace_s}")
+    if ec.min_workers < 1:
+        raise ConfigError(
+            f"elastic_min_workers must be >= 1, got {ec.min_workers}")
+    if ec.worker < -1:
+        raise ConfigError(
+            f"elastic_worker must be >= 0 (or -1 = auto), got "
+            f"{ec.worker}")
+    if ec.capacity < 0:
+        raise ConfigError(
+            f"elastic_capacity must be >= 0 (0 = local device count), "
+            f"got {ec.capacity}")
+    return ec
+
+
 # -- IO retry policy ----------------------------------------------------------
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,29 @@
+"""cxxnet_tpu.elastic — elastic, preemption-tolerant training.
+
+ROADMAP item 4 as a first-class scenario: set ``elastic_dir`` on any
+train config and the task driver runs the round loop as an elastic
+worker — file/ledger-based membership with heartbeats and a monotonic
+generation counter (:mod:`.coordinator`), topology-change resume that
+reshards params AND optimizer state onto the new dp width through the
+rule-driven shard/gather fns (:mod:`.resume`), and SIGTERM-grace
+preemption handling plus a straggler demotion advisory
+(:mod:`.preempt`). Chaos-proven by tools/smoke_elastic.py; runbook in
+doc/elastic_runbook.md.
+"""
+
+from .coordinator import (ElasticCoordinator, ElasticState,
+                          TopologyChanged, agree, plan_rendezvous,
+                          rendezvous_jax_distributed)
+from .preempt import (DemotionAdvisor, Preempted, PreemptHandler,
+                      chain_signal_handler)
+from .resume import (carry_trainer_state, reshard_tree, restore_blob,
+                     resume_latest)
+
+__all__ = [
+    "ElasticCoordinator", "ElasticState", "TopologyChanged", "agree",
+    "plan_rendezvous", "rendezvous_jax_distributed",
+    "DemotionAdvisor", "Preempted", "PreemptHandler",
+    "chain_signal_handler",
+    "carry_trainer_state", "reshard_tree", "restore_blob",
+    "resume_latest",
+]

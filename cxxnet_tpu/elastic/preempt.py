@@ -1,0 +1,177 @@
+"""Preemption grace handling + straggler demotion advisory.
+
+Cloud schedulers deliver preemption as SIGTERM-then-SIGKILL with a
+notice window (30-120 s typically). The handler here turns that into
+the elastic protocol's graceful path: set a flag the train loop polls
+at step boundaries, let the driver write an **emergency checkpoint**
+inside the window, post the departure notice (so peers bump the
+generation immediately instead of waiting out the heartbeat timeout),
+and exit 0 — a preemption is a normal lifecycle event, not a crash.
+
+Signal-handler policy: **chain, never clobber**. The serve server
+(serve/server.py) installs its own SIGTERM/SIGINT drain handler at
+``start()``; when train+serve share a process (hot-reload topologies)
+both concerns must fire on one signal. Every handler this codebase
+installs therefore saves the previous handler and invokes it after its
+own work (``SIG_DFL``/``SIG_IGN``/the C-level default are not
+callable-chained, and ``signal.default_int_handler`` is excluded —
+re-raising KeyboardInterrupt from inside a grace path would abort the
+very drain the handler exists to run). Regression-tested in
+tests/test_elastic.py and tests/test_serve_fleet.py.
+
+:class:`DemotionAdvisor` consumes the fleet layer's windowed straggler
+verdicts (telemetry/anomaly.StragglerDetector — PR 7) and turns them
+into an **advisory**: an ``elastic_advice`` ledger event recommending
+the slow host be dropped at the next generation. Advisory by design —
+membership changes stay operator- or scheduler-driven; the advice is
+the audit trail that says the fleet layer SAW the slow host.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..telemetry.ledger import LEDGER
+from ..telemetry.registry import REGISTRY
+
+
+class Preempted(RuntimeError):
+    """Raised out of the train loop when a preemption notice arrived —
+    the driver writes the grace checkpoint and leaves gracefully."""
+
+
+def chain_signal_handler(signum: int, prev) -> None:
+    """Invoke the previously installed handler ``prev`` after the
+    current one already ran, iff it is a chainable Python-level
+    handler. The single definition of what 'chain to the previous
+    handler' means (serve/server.py uses it too): SIG_DFL / SIG_IGN /
+    None (C-level handler) have no Python callable to invoke, and
+    ``signal.default_int_handler`` would raise KeyboardInterrupt
+    mid-drain."""
+    if callable(prev) and prev is not signal.default_int_handler:
+        prev(signum, None)
+
+
+class PreemptHandler:
+    """SIGTERM -> preemption flag, chained to whatever was installed
+    before. ``requested`` is the cheap per-step poll; ``deadline``
+    (monotonic) is when the notice window ends — the emergency
+    checkpoint should be on disk by then.
+
+    Main-thread-only install (CPython's signal contract), like the
+    serve server: embedded/test callers on other threads get a no-op
+    install and can drive :meth:`notice` programmatically."""
+
+    def __init__(self, grace_s: float = 10.0):
+        self.grace_s = float(grace_s)
+        self._evt = threading.Event()
+        self.deadline: Optional[float] = None
+        self._prev: Dict[int, Any] = {}
+        self._sig = None
+        self._installed = False
+        self._c = REGISTRY.counter(
+            "cxxnet_preemptions_total",
+            "Preemption notices (SIGTERM or programmatic) received")
+
+    @property
+    def requested(self) -> bool:
+        return self._evt.is_set()
+
+    def notice(self) -> None:
+        """Record a preemption notice (signal path and programmatic
+        path converge here). Idempotent: repeated SIGTERMs neither
+        extend the deadline nor double-count."""
+        if self._evt.is_set():
+            return
+        self.deadline = time.monotonic() + self.grace_s
+        self._c.inc()
+        self._evt.set()
+
+    def remaining_s(self) -> float:
+        """Seconds left in the notice window (grace_s before any
+        notice arrived)."""
+        if self.deadline is None:
+            return self.grace_s
+        return max(0.0, self.deadline - time.monotonic())
+
+    def install(self) -> bool:
+        if threading.current_thread() is not threading.main_thread():
+            return False
+
+        def _sig(signum, frame):
+            self.notice()
+            chain_signal_handler(signum, self._prev.get(signum))
+
+        try:
+            self._prev[signal.SIGTERM] = signal.signal(signal.SIGTERM,
+                                                       _sig)
+        except (ValueError, OSError):
+            return False
+        self._sig = _sig
+        self._installed = True
+        return True
+
+    def uninstall(self) -> None:
+        """Restore the pre-install handler — but ONLY where this
+        handler is still the installed one. A later installer (e.g.
+        ServeServer.start() in a train+serve process) chained to us;
+        blindly rebinding would rip ITS handler out and the next
+        SIGTERM would skip its drain. When someone installed over us,
+        leave the chain alone — our link degrades to a set() on an
+        event nobody reads, which is harmless."""
+        if not self._installed:
+            return
+        for signum, prev in self._prev.items():
+            try:
+                if signal.getsignal(signum) is self._sig:
+                    signal.signal(signum, prev)
+            except (ValueError, OSError):
+                pass
+        self._prev = {}
+        self._installed = False
+
+
+class DemotionAdvisor:
+    """Straggler verdicts x elastic membership -> demotion advice.
+
+    ``advise(verdicts, members)`` maps each flagged telemetry host to
+    the elastic worker registered under that host id and emits ONE
+    ``elastic_advice`` ledger event per onset (re-armed when the host
+    recovers, the StragglerDetector dedupe idiom). Returns the worker
+    ids currently advised for demotion — the coordinator records them
+    in the next ``topology_change`` event; nothing is force-dropped."""
+
+    def __init__(self):
+        self._advised: set = set()
+        self._c = REGISTRY.counter(
+            "cxxnet_elastic_demotion_advice_total",
+            "Straggler-demotion advisories issued",
+            labels=("worker",))
+
+    def advise(self, verdicts: List[Dict[str, Any]],
+               members: Dict[int, Dict[str, Any]]) -> List[int]:
+        # verdicts are keyed by TELEMETRY host; member records carry
+        # the host each worker reports under ("host" field, defaulting
+        # to the worker id), so divergent elastic_worker/telemetry_host
+        # configs still map back to the right worker
+        by_host = {int(rec.get("host", w)): w
+                   for w, rec in members.items()}
+        flagged = []
+        for v in verdicts or []:
+            w = by_host.get(v.get("host"))
+            if w is not None:
+                flagged.append((int(w), v))
+        current = {w for w, _v in flagged}
+        for w, v in flagged:
+            if w not in self._advised:
+                self._c.labels(str(w)).inc()
+                LEDGER.event("elastic_advice", worker=w,
+                             action="demote",
+                             ratio=v.get("ratio"),
+                             median_s=v.get("median_s"),
+                             fleet_median_s=v.get("fleet_median_s"))
+        self._advised = current
+        return sorted(current)

@@ -1,0 +1,127 @@
+"""Topology-change resume: newest verified checkpoint onto a NEW mesh.
+
+What makes elasticity *correct* rather than merely available:
+
+* **Mesh-shape independence.** Checkpoints hold fully-gathered fp32
+  masters (``Trainer.save_model`` gathers before writing), and the
+  restore places every param/optimizer-state leaf through the
+  rule-driven shard fns (``parallel/rules.make_shard_and_gather_fns``
+  over the ``Network.partition_rules`` table — ``Trainer._place``).
+  A blob written at dp=2 therefore restores bit-identically at dp=1
+  or dp=8; ``shard_B(gather_A(shard_A(tree)))`` is the lossless
+  round-trip tests/test_partition_rules.py pins, fp16 loss-scaler
+  subtree (``opt_state["_mp"]``) included (``Optimizer.adapt_state``
+  carries it across policies/widths).
+
+* **Deterministic data position.** The checkpoint meta already carries
+  the rng-stream position (``step_count`` — the key re-derives as
+  ``fold_in(base_key, step_count)``, the PR-3 rollback contract) and
+  the iterator position (``round`` — every iterator's epoch restarts
+  from ``before_first()``, and the in-repo iterators are
+  seed-deterministic per epoch). Resuming at ``round + 1`` therefore
+  replays the SAME sample sequence the uninterrupted run would have
+  seen at the same global batch, with the rng stream a pure function
+  of the meta: ANY two resumes from one checkpoint at one mesh shape
+  are bit-identical (the chaos smoke's survivor-vs-control check),
+  and cross-width trajectories differ by reduction order only
+  (tools/smoke_elastic.py asserts both).
+
+``resume_latest`` is the piece the elastic worker loop calls at every
+leadership stint; :func:`carry_trainer_state` is the in-memory variant
+for width changes that keep the same process alive (DCN-mode scale-up
+without a checkpoint round-trip).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+from .. import checkpoint as ckpt
+from ..telemetry.ledger import LEDGER
+
+
+def resume_latest(trainer, model_dir: str, *, silent: bool = True,
+                  sweep_tmp: bool = True) -> Optional[int]:
+    """Restore the newest VERIFIED checkpoint onto ``trainer``'s
+    (possibly brand-new) mesh. Returns the restored round, or None
+    when no valid checkpoint exists (caller init_model()s from
+    scratch). Corrupt/truncated archives — e.g. the one a preempted
+    leader was mid-write on — are skipped by the verification scan
+    exactly like the ``continue=1`` path."""
+    latest = ckpt.find_latest_valid(model_dir, want_blob=True,
+                                    sweep_tmp=sweep_tmp,
+                                    verbose=not silent)
+    if latest is None:
+        return None
+    r, path, blob = latest
+    restore_blob(trainer, blob, path=path)
+    if not silent:
+        print(f"elastic: resumed round {r} ({path}) onto dp="
+              f"{trainer.mesh.data_parallel} (step_count="
+              f"{trainer._step_count}, lr_scale="
+              f"{trainer.optimizer.lr_scale:g})", flush=True)
+    return r
+
+
+def restore_blob(trainer, blob: Dict[str, Any], path: str = "") -> None:
+    """Place an already-verified checkpoint blob onto the trainer's
+    current mesh. Rides ``Trainer.load_blob`` — the one restore path —
+    which places params and optimizer state through the rule-driven
+    shard fns, injects/drops the fp16 ``_mp`` scaler subtree to match
+    the current policy, and restores the rng-stream position
+    (``step_count``) and sentinel LR backoff (``lr_scale``)."""
+    trainer.load_blob(blob)
+    m = blob["meta"]
+    LEDGER.event("elastic_resume", round=int(m["round"]), path=path,
+                 step_count=int(m.get("step_count", 0)),
+                 lr_scale=float(m.get("lr_scale", 1.0)),
+                 dp=trainer.mesh.data_parallel,
+                 devices=trainer.mesh.num_devices)
+
+
+def reshard_tree(tree, old_ctx, new_ctx, old_specs, new_specs
+                 ) -> Any:
+    """One pytree across mesh shapes: gather on the old mesh (every
+    leaf back to fully-replicated host-reachable form), then shard
+    through the new mesh's rule-driven fns. The lossless primitive
+    under :func:`carry_trainer_state` and the 4->2->4 round-trip
+    test."""
+    from ..parallel.rules import make_shard_and_gather_fns
+    _, gather = make_shard_and_gather_fns(old_ctx, old_specs)
+    shard, _ = make_shard_and_gather_fns(new_ctx, new_specs)
+    return shard(ckpt.jax_to_numpy(gather(tree)))
+
+
+def carry_trainer_state(src, dst) -> None:
+    """In-memory topology change: move params / optimizer state / net
+    state / counters from trainer ``src`` onto trainer ``dst`` (built
+    over a different mesh width) without a checkpoint round-trip —
+    the DCN-mode scale-up path where the process survives the
+    generation bump. Same structure required (same config)."""
+    if src.graph.structure_signature() != dst.graph.structure_signature():
+        raise ValueError("carry_trainer_state: source and destination "
+                         "trainers run different net structures")
+    src.wait_saves()
+    src_p = src._param_pspecs(src.params)
+    dst_p = dst._param_pspecs(src.params)
+    dst.params = reshard_tree(src.params, src.mesh, dst.mesh,
+                              src_p, dst_p)
+    dst.net_state = dst.mesh.replicate(ckpt.jax_to_numpy(
+        src.mesh.gather(src.net_state)))
+    opt = reshard_tree(src.opt_state, src.mesh, dst.mesh,
+                       src.optimizer.state_pspecs(src_p),
+                       dst.optimizer.state_pspecs(dst_p))
+    dst.opt_state = dst.optimizer.adapt_state(opt)
+    dst._init_accum(ckpt.jax_to_numpy(dst.mesh.gather(dst.params)))
+    dst.round_counter = src.round_counter
+    dst.epoch_counter = src.epoch_counter
+    dst.sample_counter = src.sample_counter
+    dst._step_count = src._step_count
+    dst._rng_key = None            # re-derives from step_count
+    dst.optimizer.lr_scale = src.optimizer.lr_scale
+    dst._sched_cache = None
+    dst._sched_stack_cache = None
+    LEDGER.event("elastic_resume", round=dst.round_counter,
+                 step_count=dst._step_count, in_memory=True,
+                 dp=dst.mesh.data_parallel,
+                 devices=dst.mesh.num_devices)

@@ -20,7 +20,8 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from .config import (ConfigPairs, parse_cli_overrides, parse_config_file,
-                     parse_retry_policy, parse_telemetry_config)
+                     parse_elastic_config, parse_retry_policy,
+                     parse_telemetry_config)
 from .graph import global_param
 from .io.data import DataBatch, create_iterator
 from .resilience import SentinelAbort, TrainingSentinel, counters, failpoints
@@ -151,6 +152,15 @@ class LearnTask:
         # one-step transient spikes too).
         self.sentinel_interval = max(1, int(gp("sentinel_interval", "8")))
         self.sentinel: Optional[TrainingSentinel] = None
+        # -- elastic training (doc/tasks.md "Elastic training") -----------
+        # elastic_dir set = the train task runs as an elastic worker:
+        # membership + heartbeats + generation agreement, topology-
+        # change resume onto a new dp width, SIGTERM-grace preemption
+        self.elastic = parse_elastic_config(self.global_cfg)
+        self._preempt = None          # PreemptHandler during elastic runs
+        self._elastic_cb = None       # per-round topology check
+        self._elastic_step_cb = None  # heartbeat-gated per-step check
+        self._cur_round: Optional[int] = None
         # dev=cpu must be pinned BEFORE the first device query
         # (jax.process_index below): a remote-attached accelerator plugin
         # (axon tunnel) initializes eagerly on that query and a dead link
@@ -352,6 +362,8 @@ class LearnTask:
                 ready=self.trainer.last_loss_handle, status=status)
 
     def task_train(self) -> None:
+        if self.elastic.enabled and not self.test_io:
+            return self.task_train_elastic()
         tr = self.trainer
         self._init_model()
         itr_train = self.train_iter()
@@ -373,11 +385,19 @@ class LearnTask:
                 jax.profiler.stop_trace()
                 if not self.silent:
                     print(f"profiler trace written to {self.profile_dir}")
+        self._final_save(tr)
+
+    def _final_save(self, tr) -> None:
+        """Final-model tail shared by task_train and the elastic
+        finish: drain any pending async PERIODIC write tolerantly (its
+        failure is covered by the degrade-don't-die contract and must
+        not abort before the final model is attempted), write the
+        final model if the last round's periodic save didn't, then
+        wait STRICTLY — the FINAL write's failure raises, because
+        exiting 0 without the artifact the run exists to produce would
+        be a lie."""
         if self.save_model and not self.test_io:
             from .io import stream
-            # drain any pending async PERIODIC write tolerantly first —
-            # its failure is covered by the degrade-don't-die contract
-            # and must not abort before the final model is attempted
             try:
                 tr.wait_saves()
             except RuntimeError as e:
@@ -392,9 +412,264 @@ class LearnTask:
                 getattr(self, "_end_round", self.num_round) - 1)
             if not stream.exists(final):
                 tr.save_model(final)
-        # the FINAL model's write failure still raises — exiting 0
-        # without the artifact the run exists to produce would be a lie
         tr.wait_saves()
+
+    # -- elastic training (doc/tasks.md "Elastic training") ----------------
+    def task_train_elastic(self) -> None:
+        """ROADMAP-4 scenario: the round loop as an elastic worker.
+        Membership/heartbeats/generation agreement live in
+        ``elastic_dir`` (elastic/coordinator.py); at every leadership
+        stint the newest VERIFIED checkpoint is restored onto a mesh
+        of the agreed dp width through the rule-driven shard fns
+        (elastic/resume.py), so a worker loss mid-run reshards e.g.
+        dp 2 -> 1 and resumes at the exact rng/iterator position; a
+        SIGTERM preemption notice gets a grace checkpoint and an
+        immediate departure notice (elastic/preempt.py). Chaos-proven
+        by tools/smoke_elastic.py; runbook: doc/elastic_runbook.md."""
+        import jax
+        from .elastic import (DemotionAdvisor, ElasticCoordinator,
+                              Preempted, PreemptHandler)
+        from .elastic import TopologyChanged
+        from .elastic import resume as elastic_resume
+        from .io import stream
+        gp = lambda n, d: global_param(self.global_cfg, n, d)
+        if any(int(gp(k, "1")) != 1 for k in
+               ("model_parallel", "seq_parallel", "pipeline_parallel")):
+            raise ValueError(
+                "elastic training composes with data parallelism only "
+                "(the dp width IS the elastic degree of freedom); "
+                "clear model_parallel/seq_parallel/pipeline_parallel")
+        if jax.process_count() > 1:
+            raise ValueError(
+                "elastic_dir with a jax.distributed multi-rank job is "
+                "the DCN mode: drive one single-process worker per "
+                "host (examples/multi-machine/elastic_worker.py) and "
+                "see doc/elastic_runbook.md for the rendezvous story")
+        if not self.save_model or self.save_period < 1:
+            # verified checkpoints are the topology-handoff medium AND
+            # the completion evidence — without them a takeover
+            # restarts from scratch and the completion marker can
+            # never be validated (standbys would reopen a finished
+            # run forever). save_period=0 ("never save periodically")
+            # defeats the handoff just as thoroughly as save_model=0.
+            raise ValueError(
+                "elastic training requires save_model=1 and "
+                "save_period >= 1: periodic verified checkpoints are "
+                "how survivors take over and how the completion "
+                "marker is validated")
+        ndev = len(jax.devices())
+        worker = self.elastic.worker if self.elastic.worker >= 0 \
+            else self._tel_host
+        capacity = self.elastic.capacity or ndev
+        if capacity > ndev:
+            # an over-declared capacity would win leadership at a
+            # width this host cannot actually train at — every ledger
+            # record and peer decision would misreport dp. Clamp and
+            # say so.
+            if self._is_root:
+                print(f"WARNING: elastic_capacity={capacity} exceeds "
+                      f"this worker's {ndev} local device(s); "
+                      f"clamping to {ndev}", flush=True)
+            capacity = ndev
+        coord = ElasticCoordinator(
+            self.elastic.dir, worker=worker, capacity=capacity,
+            heartbeat_s=self.elastic.heartbeat_s,
+            grace_s=self.elastic.grace_s,
+            min_workers=self.elastic.min_workers,
+            host=self._tel_host,
+            silent=bool(self.silent))
+        preempt = PreemptHandler(grace_s=self.elastic.grace_s)
+        advisor = DemotionAdvisor()
+        tr = None
+        try:
+            # every side effect (global signal handler, membership
+            # registration) happens INSIDE the try: a join that fails
+            # fast (duplicate live worker id) must not leak the
+            # installed SIGTERM handler or a half-registered member
+            preempt.install()
+            self._preempt = preempt
+            stream.makedirs(self.model_dir)
+            coord.join()
+            while True:
+                st = coord.sync()
+                if st.complete:
+                    # believe the marker only if the final model
+                    # actually covers THIS config's rounds — a
+                    # leftover complete=true in a reused elastic_dir
+                    # (earlier, shorter run) must reopen, not silently
+                    # exit 0 with rounds untrained
+                    latest = ckpt.find_latest(self.model_dir)
+                    if latest is not None \
+                            and latest[0] >= self.num_round - 1:
+                        coord.leave("complete")
+                        return
+                    coord.reopen(
+                        reason=f"reopen:num_round={self.num_round}")
+                    continue
+                if preempt.requested:
+                    raise Preempted("preemption notice")
+                if not coord.trainable(st):
+                    # standby: ack the generation (a demoted leader's
+                    # ack is what releases the successor's handover
+                    # wait), keep heartbeating, poll
+                    coord.ack(st)
+                    coord.wait()
+                    continue
+                # -- leadership stint --------------------------------
+                coord.ack(st)
+                # join-triggered takeover: wait for the old leader to
+                # unwind its round loop before writing checkpoints
+                coord.wait_handover(st)
+                self._cur_round = None
+                tr = self._elastic_trainer(min(st.width, ndev))
+                r0 = elastic_resume.resume_latest(
+                    tr, self.model_dir, silent=bool(self.silent))
+                if r0 is not None:
+                    self.start_counter = r0 + 1
+                else:
+                    # fresh start honoring model_in/finetune; the
+                    # resume scan above already covered continue=1
+                    self.start_counter = 0
+                    saved = self.continue_training
+                    self.continue_training = 0
+                    try:
+                        self._init_model()
+                    finally:
+                        self.continue_training = saved
+                if self.start_counter >= self.num_round:
+                    # already fully trained: finish against num_round
+                    # — a stale _end_round from an earlier max_round-
+                    # capped stint would mislabel the final model and
+                    # skip the completion marker
+                    self._end_round = self.num_round
+                    self._elastic_finish(tr, coord)
+                    return
+                itr_train = self.train_iter()
+                if itr_train is None:
+                    raise ValueError(
+                        "no training data section (data = ...) in config")
+                evals = self.eval_iters()
+                self._elastic_cb = self._make_elastic_cb(
+                    coord, advisor, st.width)
+                self._elastic_step_cb = self._make_elastic_step_cb(
+                    coord, st.width)
+                try:
+                    self._train_rounds(tr, itr_train, evals)
+                except TopologyChanged:
+                    # drain any in-flight ASYNC checkpoint write before
+                    # the loop re-syncs and acks the new generation —
+                    # the ack is the successor's license to write, and
+                    # it must not fire while our save thread still owns
+                    # the round file (save_async=1)
+                    try:
+                        tr.wait_saves()
+                    except RuntimeError as e:
+                        counters.inc("ckpt.write_failures")
+                        if self._is_root:
+                            print(f"WARNING: async checkpoint write "
+                                  f"failed during handover: {e}",
+                                  flush=True)
+                    continue       # demoted / width moved: re-sync
+                finally:
+                    self._elastic_cb = None
+                    self._elastic_step_cb = None
+                self._elastic_finish(tr, coord)
+                return
+        except Preempted:
+            self._elastic_preempt_exit(tr, coord, preempt)
+        finally:
+            self._elastic_cb = None
+            self._elastic_step_cb = None
+            self._preempt = None
+            preempt.uninstall()
+            coord.close()
+
+    def _elastic_trainer(self, width: int) -> Trainer:
+        """Build (and adopt) a Trainer over the first ``width`` local
+        devices — the agreed dp width of this generation."""
+        import jax
+        from .parallel import make_mesh_context
+        ctx = make_mesh_context(devices=jax.devices()[:max(1, width)])
+        tr = Trainer(self.global_cfg, mesh_ctx=ctx)
+        self.trainer = tr
+        if self.telemetry.watchdog is not None:
+            self.telemetry.watchdog.progress_fn = \
+                lambda: tr._step_count
+        return tr
+
+    def _make_elastic_cb(self, coord, advisor, acting_width: int):
+        """Round-boundary elastic housekeeping: feed the straggler-
+        demotion advisory from the fleet layer's windowed verdicts,
+        then raise TopologyChanged if this worker's role (leadership
+        or agreed width) moved."""
+        def cb(_r: int) -> None:
+            # unconditionally: an EMPTY verdict list is the recovery
+            # signal that re-arms the advisory dedupe
+            advisor.advise(
+                getattr(self.telemetry, "last_straggler_verdicts", []),
+                coord.members())
+            coord.raise_on_change(acting_width)
+        return cb
+
+    def _make_elastic_step_cb(self, coord, acting_width: int):
+        """Step-granular demotion poll, gated to at most one
+        coordinator sync per heartbeat period: cheap enough to sit in
+        the batch loop, frequent enough that a leader whose rounds run
+        long still yields within ~a step of losing leadership (the
+        abandoned partial round has no checkpoint, so the successor's
+        resume stays consistent — same semantics as a SIGKILL)."""
+        state = {"next": 0.0}
+
+        def cb() -> None:
+            now = time.monotonic()
+            if now < state["next"]:
+                return
+            state["next"] = now + coord.heartbeat_s
+            coord.raise_on_change(acting_width)
+        return cb
+
+    def _elastic_finish(self, tr, coord) -> None:
+        """Final-model tail of an elastic run (shared with task_train),
+        then mark the run complete so standbys exit instead of
+        electing a leader for a finished job. A stint capped by
+        ``max_round`` below ``num_round`` is a budgeted exit, NOT
+        completion — marking it complete would block every future
+        worker from training the remaining rounds."""
+        self._final_save(tr)
+        if getattr(self, "_end_round", self.num_round) >= self.num_round:
+            coord.mark_complete()
+            coord.leave("complete")
+        else:
+            coord.leave("max_round")
+
+    def _elastic_preempt_exit(self, tr, coord, preempt) -> None:
+        """SIGTERM grace path: emergency checkpoint inside the notice
+        window (best effort, degradation-tolerant — and only while
+        still the leader: a demoted standby must not overwrite its
+        successor's rounds), immediate departure notice, exit 0 — a
+        preemption is a normal lifecycle event, not a crash."""
+        from .io import stream
+        st = coord.read_state()
+        r = self._cur_round
+        if (tr is not None and tr.params is not None and r is not None
+                and self.save_model and st is not None
+                and st.leader == coord.worker
+                and preempt.remaining_s() > 0):
+            path = ckpt.model_path(self.model_dir, r)
+            if not stream.exists(path):
+                # partial-round params saved AS round r: the successor
+                # resumes at r+1 — freshness over strict determinism
+                # inside the preempted round (doc/elastic_runbook.md)
+                self._save_round(tr, r)
+                try:
+                    tr.wait_saves()
+                except RuntimeError:
+                    counters.inc("ckpt.write_failures")
+        coord.leave("preempt")
+        if not self.silent:
+            print(f"elastic: preempted; grace checkpoint round "
+                  f"{r if r is not None else '-'}, left gracefully",
+                  flush=True)
 
     # -- resilience hooks --------------------------------------------------
     def _sentinel_step(self, tr, r: int, losses=None,
@@ -497,9 +772,22 @@ class LearnTask:
 
     def _timed_batches(self, it, probe):
         """Wrap a batch source so each fetch's host-blocked time is
-        banked into the step-time probe (data-wait) and traced."""
+        banked into the step-time probe (data-wait) and traced. Also
+        the per-step preemption poll: a SIGTERM notice stops the
+        dispatch of further steps HERE (one event check per batch) so
+        the grace window is spent writing the emergency checkpoint,
+        not finishing the round."""
         it = iter(it)
         while True:
+            if self._preempt is not None and self._preempt.requested:
+                from .elastic import Preempted
+                raise Preempted("preemption notice mid-round")
+            if self._elastic_step_cb is not None:
+                # heartbeat-gated demotion poll: a leader whose ROUNDS
+                # outlast the handover wait must still notice a
+                # join-triggered demotion within ~a step, or the
+                # successor's timeout would open a two-writers window
+                self._elastic_step_cb()
             t0 = time.perf_counter()
             try:
                 batch = next(it)
@@ -546,6 +834,7 @@ class LearnTask:
                 "with pp, nor with accumulation under sp")
         for r in range(self.start_counter, end_round):
             tr.start_round(r)
+            self._cur_round = r      # the grace checkpoint's round label
             batch_count = 0
             n_images = 0
             round_start = time.time()
@@ -678,6 +967,11 @@ class LearnTask:
                 # rollback BEFORE this round is checkpointed
                 self._sentinel_step(tr, r, force=True)
                 self._save_round(tr, r)
+            # elastic topology check AFTER the checkpoint write: a
+            # demotion must never unwind past an unsaved round (the
+            # successor resumes from what is on disk)
+            if self._elastic_cb is not None:
+                self._elastic_cb(r)
 
     def task_serve(self) -> None:
         """Online inference endpoint (serve/): the request-driven analog

@@ -418,17 +418,28 @@ class ServeServer:
         — their host process owns signal policy. The handler restores
         the previous handlers FIRST (it runs on the main thread, the
         only place that's legal — a stop() driven from the sigwatch
-        thread could never do it), then sets the event: the first
-        signal drains gracefully, a second one gets the host's
+        thread could never do it), sets the event, then CHAINS to the
+        previous handler: when train+serve share a process the elastic
+        preemption handler (elastic/preempt.py) was installed before
+        this one, and one SIGTERM must both drain the server and start
+        the grace-checkpoint path — neither concern may clobber the
+        other (regression: tests/test_serve_fleet.py,
+        tests/test_elastic.py). A second signal still gets the host's
         original behavior (e.g. force-kill), and a drained server
         never keeps swallowing the process's signals."""
         import signal
         if threading.current_thread() is not threading.main_thread():
             return
+        # bound HERE, at install time, never inside the handler: a
+        # first-ever import executed in signal context could observe a
+        # partially initialized module and blow up mid-drain
+        from ..elastic.preempt import chain_signal_handler
 
         def _sig(signum, _frame):
+            prev = self._prev_handlers.get(signum)
             self._restore_signal_handlers()
             self._stop_evt.set()
+            chain_signal_handler(signum, prev)
 
         for signum in (signal.SIGINT, signal.SIGTERM):
             try:

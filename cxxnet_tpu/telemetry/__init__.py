@@ -77,6 +77,10 @@ class TelemetrySession:
         self.straggler: Optional[StragglerDetector] = None
         self.watchdog: Optional[HangWatchdog] = None
         self.storm: Optional[RecompileStormDetector] = None
+        # the aggregating host's most recent windowed straggler
+        # verdicts — the elastic demotion advisory reads them at round
+        # boundaries (elastic/preempt.DemotionAdvisor)
+        self.last_straggler_verdicts: list = []
         # run identity: explicit knob > env (so N processes of one run
         # launched by a driver share one id) > fresh
         self.run_id = (cfg.run_id or os.environ.get("CXXNET_RUN_ID")
@@ -164,6 +168,7 @@ class TelemetrySession:
             return ""
         view = self.aggregator.view()
         verdicts = self.straggler.check(view, round_no)
+        self.last_straggler_verdicts = verdicts
         frag = ""
         if len(view.hosts) > 1:
             meds = []

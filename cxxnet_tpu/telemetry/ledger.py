@@ -63,6 +63,9 @@ KNOWN_EVENTS = (
     "breaker_transition", "hang_dump", "straggler", "recompile_storm",
     # serving fleet (serve/fleet.py, serve/reload.py, serve/server.py)
     "serve_start", "weights_reload", "replica_state",
+    # elastic training (elastic/coordinator.py, resume.py, preempt.py)
+    "elastic_join", "elastic_leave", "topology_change",
+    "elastic_resume", "elastic_advice",
 )
 
 

@@ -472,14 +472,22 @@ class Trainer:
     def _place(self, params, net_state=None, opt_state=None):
         """Shard params (TP specs from the layers; size-1 model axis =
         replicated; pipe-FSDP specs under pp), mirror the sharding onto
-        optimizer state, replicate the small net state."""
+        optimizer state, replicate the small net state. Placement goes
+        through the rule-driven shard fns (parallel/rules.
+        make_shard_and_gather_fns over the spec trees) — the same
+        mechanism the elastic topology-change resume relies on, so a
+        checkpoint written at one dp width restores losslessly at
+        another (elastic/resume.py, tests/test_partition_rules.py)."""
+        from .parallel.rules import make_shard_and_gather_fns
         pspecs = self._param_pspecs(params)
-        out = [self.mesh.shard_params(params, pspecs)]
+        shard_p, _ = make_shard_and_gather_fns(self.mesh, pspecs)
+        out = [shard_p(params)]
         if net_state is not None:
             out.append(self.mesh.replicate(net_state))
         if opt_state is not None:
-            out.append(self.mesh.shard_params(
-                opt_state, self.optimizer.state_pspecs(pspecs)))
+            shard_o, _ = make_shard_and_gather_fns(
+                self.mesh, self.optimizer.state_pspecs(pspecs))
+            out.append(shard_o(opt_state))
         return out[0] if len(out) == 1 else tuple(out)
 
     def _init_accum(self, params) -> None:

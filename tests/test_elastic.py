@@ -1,0 +1,650 @@
+"""Elastic training (cxxnet_tpu/elastic/): membership/generation
+agreement, topology-change resume across dp widths, preemption grace,
+signal-handler chaining, demotion advisory, report timeline.
+
+The multi-process chaos proof lives in tools/smoke_elastic.py (verify
+recipe); these tests pin the in-process contracts with injected clocks
+so nothing here sleeps out a real heartbeat timeout.
+"""
+
+import json
+import os
+import signal
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from cxxnet_tpu import checkpoint as ckpt
+from cxxnet_tpu.config import (ConfigError, parse_config_string,
+                               parse_elastic_config)
+from cxxnet_tpu.elastic import (DemotionAdvisor, ElasticCoordinator,
+                                Preempted, PreemptHandler,
+                                TopologyChanged, agree,
+                                carry_trainer_state,
+                                chain_signal_handler, plan_rendezvous,
+                                rendezvous_jax_distributed,
+                                resume_latest)
+from cxxnet_tpu.parallel import make_mesh_context
+from cxxnet_tpu.trainer import Trainer
+
+NET_CFG = """
+netconfig=start
+layer[+1:h1] = fullc:fc1
+  nhidden = 16
+  random_type = xavier
+layer[+1:a1] = relu
+layer[a1->out] = fullc:fc2
+  nhidden = 4
+  random_type = xavier
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,8
+batch_size = 8
+eta = 0.1
+momentum = 0.9
+eval_train = 0
+"""
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make_coord(tmp_path, worker, capacity, clock, hb=1.0, **kw):
+    c = ElasticCoordinator(str(tmp_path / "elastic"), worker=worker,
+                           capacity=capacity, heartbeat_s=hb,
+                           silent=True, clock=clock, **kw)
+    return c
+
+
+def join_no_thread(coord):
+    """Register without the daemon heartbeat thread — tests drive
+    liveness purely through the injected clock + explicit writes."""
+    coord.joined_ts = coord.clock()
+    try:
+        os.remove(coord._leave_path(coord.worker))
+    except OSError:
+        pass
+    coord._write_heartbeat()
+
+
+# -- config namespace -----------------------------------------------------
+
+@pytest.mark.quick
+def test_elastic_config_validation():
+    ec = parse_elastic_config([("elastic_dir", "/tmp/e"),
+                               ("elastic_heartbeat_s", "0.5"),
+                               ("elastic_min_workers", "2"),
+                               ("elastic_capacity", "4")])
+    assert ec.enabled and ec.heartbeat_s == 0.5 and ec.min_workers == 2
+    assert not parse_elastic_config([]).enabled
+    with pytest.raises(ConfigError):
+        parse_elastic_config([("elastic_heartbeats", "1")])      # typo
+    with pytest.raises(ConfigError):
+        parse_elastic_config([("elastic_heartbeat_s", "0")])
+    with pytest.raises(ConfigError):
+        parse_elastic_config([("elastic_grace_s", "-1")])
+    with pytest.raises(ConfigError):
+        parse_elastic_config([("elastic_min_workers", "0")])
+    with pytest.raises(ConfigError):
+        parse_elastic_config([("elastic_capacity", "-2")])
+    with pytest.raises(ConfigError):
+        parse_elastic_config([("elastic_worker", "nope")])
+
+
+# -- agreement rule -------------------------------------------------------
+
+@pytest.mark.quick
+def test_agree_local_and_jaxdist_modes():
+    live = {0: {"capacity": 2}, 1: {"capacity": 1}, 2: {"capacity": 2}}
+    # local mode: max capacity wins, tie -> lowest id; width = capacity
+    assert agree(live) == {"leader": 0, "width": 2}
+    assert agree({1: {"capacity": 1}}) == {"leader": 1, "width": 1}
+    # jaxdist mode: lowest id hosts the coordinator; width = fleet size
+    assert agree(live, jaxdist=True) == {"leader": 0, "width": 3}
+    assert agree({}) == {"leader": -1, "width": 0}
+
+
+# -- membership / generations ---------------------------------------------
+
+@pytest.mark.quick
+def test_membership_staleness_and_leave_notice(tmp_path):
+    clock = FakeClock()
+    c0 = make_coord(tmp_path, 0, 2, clock)
+    c1 = make_coord(tmp_path, 1, 1, clock)
+    join_no_thread(c0)
+    join_no_thread(c1)
+    assert sorted(c0.members()) == [0, 1]
+    # heartbeat goes stale after 2 x heartbeat_s without a write
+    clock.advance(2.5)
+    c1._write_heartbeat()
+    assert sorted(c0.members()) == [1]
+    # a fresh write revives; a leave notice kills immediately
+    c0._write_heartbeat()
+    assert sorted(c1.members()) == [0, 1]
+    c0.leave("test")
+    assert sorted(c1.members()) == [1]
+
+
+@pytest.mark.quick
+def test_join_rejects_duplicate_live_worker_id(tmp_path):
+    """Two processes launched with the same elastic_worker id would
+    BOTH pass the leadership check — the one failure mode the
+    generation protocol cannot see, so join() fails fast on a LIVE
+    same-id member owned by another pid; a stale record (dead
+    previous incarnation) is taken over normally."""
+    from cxxnet_tpu.elastic.coordinator import _atomic_write_json
+    clock = FakeClock()
+    c = make_coord(tmp_path, 0, 2, clock)
+    # a live record owned by some OTHER process
+    _atomic_write_json(c._member_path(0), {
+        "worker": 0, "pid": os.getpid() + 1, "capacity": 2,
+        "ts": clock(), "joined_ts": clock()})
+    with pytest.raises(RuntimeError, match="already LIVE"):
+        c.join()
+    # ... but a stale one (previous incarnation died) is reclaimable
+    clock.advance(2.5)
+    c.join()
+    c.leave("test")
+
+
+@pytest.mark.quick
+def test_generation_bump_monotonic_and_designated_bumper(tmp_path):
+    clock = FakeClock()
+    c0 = make_coord(tmp_path, 0, 2, clock)
+    c1 = make_coord(tmp_path, 1, 1, clock)
+    join_no_thread(c0)
+    # only the lowest live id bumps: c1's sync before joining itself
+    # sees no record of its own making
+    join_no_thread(c1)
+    st1 = c1.sync()
+    assert st1.gen == 0 and st1.leader == -1   # waiting for the bumper
+    st = c0.sync()
+    assert st.gen == 1 and st.leader == 0 and st.width == 2
+    assert st.members == (0, 1)
+    # no drift -> no new generation
+    assert c0.sync().gen == 1
+    # lost leader: the remaining worker is now the designated bumper
+    clock.advance(2.5)
+    c1._write_heartbeat()
+    st = c1.sync()
+    assert st.gen == 2 and st.leader == 1 and st.width == 1
+    # rejoin with higher capacity wins leadership back
+    join_no_thread(c0)
+    st = c0.sync()
+    assert st.gen == 3 and st.leader == 0 and st.width == 2
+    assert st.members == (0, 1)
+
+
+@pytest.mark.quick
+def test_capacity_change_same_membership_retunes(tmp_path):
+    """A same-id replacement with different capacity leaves the
+    membership ID set unchanged — the agreement itself must drift
+    (width/leader retune), or the fleet trains at a stale width."""
+    clock = FakeClock()
+    c0 = make_coord(tmp_path, 0, 2, clock)
+    join_no_thread(c0)
+    st = c0.sync()
+    assert st.width == 2
+    clock.advance(2.5)          # old incarnation dies (stale heartbeat)
+    c0b = make_coord(tmp_path, 0, 4, clock)
+    join_no_thread(c0b)
+    st2 = c0b.sync()
+    assert st2.gen == st.gen + 1 and st2.width == 4
+
+
+@pytest.mark.quick
+def test_raise_on_change_semantics(tmp_path):
+    clock = FakeClock()
+    c0 = make_coord(tmp_path, 0, 2, clock)
+    join_no_thread(c0)
+    st = c0.sync()
+    c0.ack(st)
+    # same role, no drift: no raise
+    c0.raise_on_change(acting_width=2)
+    # benign bump (standby joins; leader/width unchanged): acked, not
+    # raised
+    c1 = make_coord(tmp_path, 1, 1, clock)
+    join_no_thread(c1)
+    c0.raise_on_change(acting_width=2)
+    assert c0.acted_gen == c0.sync().gen
+    # demotion: a higher-capacity member joins and the next round
+    # check unwinds the loop
+    c2 = make_coord(tmp_path, 2, 4, clock)
+    join_no_thread(c2)
+    with pytest.raises(TopologyChanged):
+        c0.raise_on_change(acting_width=2)
+    # the demoted worker is no longer trainable; the new leader is
+    st = c2.sync()
+    assert st.leader == 2 and st.width == 4
+    assert not c0.trainable(st) and c2.trainable(st)
+
+
+@pytest.mark.quick
+def test_min_workers_floor_and_complete(tmp_path):
+    clock = FakeClock()
+    c0 = make_coord(tmp_path, 0, 2, clock, min_workers=2)
+    join_no_thread(c0)
+    st = c0.sync()
+    assert st.leader == 0 and not c0.trainable(st)   # floor not met
+    c1 = make_coord(tmp_path, 1, 1, clock, min_workers=2)
+    join_no_thread(c1)
+    assert c0.trainable(c0.sync())
+    c0.mark_complete()
+    st = c1.sync()
+    assert st.complete and not c1.trainable(st)
+
+
+@pytest.mark.quick
+def test_handover_wait_keys_on_acting_gen(tmp_path):
+    clock = FakeClock()
+    c0 = make_coord(tmp_path, 0, 2, clock)
+    c1 = make_coord(tmp_path, 1, 1, clock)
+    join_no_thread(c0)
+    join_no_thread(c1)
+    st = c0.sync()
+    # peer still acting on an older generation -> timeout (clock-driven)
+    c1.acted_gen = st.gen - 1
+    c1._write_heartbeat()
+    assert not c0.wait_handover(st, timeout_s=0)
+    # peer acks -> released
+    c1.ack(st)
+    assert c0.wait_handover(st, timeout_s=0)
+
+
+@pytest.mark.quick
+def test_ledger_events_emitted(tmp_path):
+    from cxxnet_tpu.telemetry.ledger import LEDGER, read_ledger
+    path = str(tmp_path / "led.jsonl")
+    LEDGER.enable(path, "test-elastic", host=0)
+    try:
+        clock = FakeClock()
+        c0 = make_coord(tmp_path, 0, 2, clock)
+        c0.join()               # real join (thread) for the event
+        st = c0.sync()
+        c0.mark_complete()
+        c0.leave("test")
+        events = [e["event"] for e in read_ledger(path)]
+        assert "elastic_join" in events and "elastic_leave" in events
+        assert events.count("topology_change") >= 2   # init + complete
+        tc = [e for e in read_ledger(path)
+              if e["event"] == "topology_change"][0]
+        assert tc["gen"] == st.gen and tc["width"] == 2 \
+            and tc["leader"] == 0 and tc["reason"] == "init"
+    finally:
+        LEDGER.disable()
+
+
+# -- jax.distributed rendezvous plan --------------------------------------
+
+@pytest.mark.quick
+def test_plan_rendezvous_deterministic_ranks():
+    from cxxnet_tpu.elastic.coordinator import ElasticState
+    st = ElasticState(gen=7, members=(1, 4, 9), leader=4, width=3)
+    members = {1: {"addr": "hostb:1234"}, 4: {"addr": "hosta:999"},
+               9: {}}
+    plan = plan_rendezvous(st, members)
+    assert plan["num_processes"] == 3
+    assert plan["ranks"] == {1: 0, 4: 1, 9: 2}
+    # coordinator on the leader's host, port salted by generation
+    host, port = plan["coordinator"].split(":")
+    assert host == "hosta" and int(port) == 47601 + 7
+
+
+@pytest.mark.quick
+def test_rendezvous_jax_distributed_calls_runtime(monkeypatch):
+    calls = []
+
+    class _Dist:
+        class global_state:
+            client = None
+
+        @staticmethod
+        def shutdown():
+            calls.append(("shutdown",))
+
+        @staticmethod
+        def initialize(**kw):
+            calls.append(("initialize", kw))
+
+    monkeypatch.setattr(jax, "distributed", _Dist)
+    plan = {"coordinator": "h:47608", "num_processes": 2,
+            "ranks": {3: 0, 5: 1}}
+    assert rendezvous_jax_distributed(plan, worker=5, silent=True)
+    assert calls == [("initialize", {
+        "coordinator_address": "h:47608", "num_processes": 2,
+        "process_id": 1, "initialization_timeout": 120})]
+
+    # an unsupported backend degrades to an explicit False, never a
+    # crash (this session's CPU jaxlib cannot run multiprocess)
+    class _Boom(_Dist):
+        @staticmethod
+        def initialize(**kw):
+            raise RuntimeError("no multiprocess CPU")
+
+    monkeypatch.setattr(jax, "distributed", _Boom)
+    assert not rendezvous_jax_distributed(plan, worker=3, silent=True)
+
+
+# -- preemption grace ------------------------------------------------------
+
+@pytest.mark.quick
+def test_preempt_handler_notice_idempotent():
+    h = PreemptHandler(grace_s=30)
+    assert not h.requested and h.remaining_s() == 30
+    h.notice()
+    assert h.requested
+    d = h.deadline
+    h.notice()                       # repeated SIGTERMs don't extend
+    assert h.deadline == d
+    assert 0 < h.remaining_s() <= 30
+
+
+@pytest.mark.quick
+def test_chain_signal_handler_rules():
+    called = []
+    chain_signal_handler(signal.SIGTERM, lambda s, f: called.append(s))
+    assert called == [signal.SIGTERM]
+    # non-callables and the KeyboardInterrupt default are not chained
+    chain_signal_handler(signal.SIGTERM, signal.SIG_DFL)
+    chain_signal_handler(signal.SIGTERM, signal.SIG_IGN)
+    chain_signal_handler(signal.SIGINT, None)
+    chain_signal_handler(signal.SIGINT, signal.default_int_handler)
+    assert called == [signal.SIGTERM]
+
+
+@pytest.mark.quick
+def test_preempt_handler_chains_previous_sigterm():
+    if threading.current_thread() is not threading.main_thread():
+        pytest.skip("signal installs are main-thread-only")
+    seen = []
+    orig = signal.signal(signal.SIGTERM, lambda s, f: seen.append("prev"))
+    try:
+        h = PreemptHandler(grace_s=5)
+        assert h.install()
+        handler = signal.getsignal(signal.SIGTERM)
+        handler(signal.SIGTERM, None)
+        assert h.requested and seen == ["prev"], \
+            "both the preempt flag and the previous handler must fire"
+        h.uninstall()
+        assert signal.getsignal(signal.SIGTERM) is not handler
+    finally:
+        signal.signal(signal.SIGTERM, orig)
+
+
+@pytest.mark.quick
+def test_preempt_uninstall_leaves_later_handler_alone():
+    """A later installer (e.g. ServeServer.start()) chained to the
+    preempt handler; uninstall() must not rip that handler out."""
+    if threading.current_thread() is not threading.main_thread():
+        pytest.skip("signal installs are main-thread-only")
+    orig = signal.getsignal(signal.SIGTERM)
+    try:
+        h = PreemptHandler(grace_s=5)
+        assert h.install()
+        later = lambda s, f: None        # serve installed over us
+        signal.signal(signal.SIGTERM, later)
+        h.uninstall()
+        assert signal.getsignal(signal.SIGTERM) is later, \
+            "uninstall clobbered a handler installed after it"
+    finally:
+        signal.signal(signal.SIGTERM, orig)
+
+
+# -- demotion advisory -----------------------------------------------------
+
+@pytest.mark.quick
+def test_demotion_advisor_dedupe_and_membership(tmp_path):
+    from cxxnet_tpu.telemetry.ledger import LEDGER, read_ledger
+    path = str(tmp_path / "led.jsonl")
+    LEDGER.enable(path, "test-advice", host=0)
+    try:
+        adv = DemotionAdvisor()
+        members = {0: {"capacity": 2}, 1: {"capacity": 1}}
+        v = [{"host": 1, "ratio": 3.2, "median_s": 0.9,
+              "fleet_median_s": 0.28}]
+        assert adv.advise(v, members) == [1]
+        assert adv.advise(v, members) == [1]     # steady state: no spam
+        # a verdict for a host that is NOT a member is ignored
+        assert adv.advise([{"host": 7, "ratio": 9.0}], members) == []
+        # recovery re-arms the advisory (the round callback feeds the
+        # advisor unconditionally, so an empty list IS the recovery)
+        assert adv.advise([], members) == []
+        assert adv.advise(v, members) == [1]
+        events = [e for e in read_ledger(path)
+                  if e["event"] == "elastic_advice"]
+        assert len(events) == 2 and all(
+            e["worker"] == 1 and e["action"] == "demote" for e in events)
+        # divergent id spaces: verdicts key on TELEMETRY host, member
+        # records carry the host each worker reports under
+        div = {10: {"capacity": 2, "host": 0},
+               11: {"capacity": 1, "host": 1}}
+        assert DemotionAdvisor().advise(v, div) == [11]
+    finally:
+        LEDGER.disable()
+
+
+# -- topology-change resume ------------------------------------------------
+
+def _train_steps(tr, n, batch=8, width=8, seed=0):
+    from cxxnet_tpu.io.data import DataBatch
+    rng = np.random.RandomState(seed)
+    for _ in range(n):
+        tr.update(DataBatch(
+            data=rng.randn(batch, 1, 1, width).astype(np.float32),
+            label=rng.randint(0, 4, (batch, 1)).astype(np.float32)))
+
+
+def test_resume_latest_reshards_across_widths(tmp_path):
+    """Save at dp=2, resume onto dp=1: params/opt bit-equal, rng
+    position (step_count) and sentinel LR scale carried, ledger event
+    emitted — the heart of the chaos smoke, in-process."""
+    from cxxnet_tpu.telemetry.ledger import LEDGER, read_ledger
+    cfg = parse_config_string(NET_CFG)
+    tr2 = Trainer(cfg, mesh_ctx=make_mesh_context(devices=jax.devices()[:2]))
+    tr2.init_model()
+    _train_steps(tr2, 3)
+    tr2.optimizer.lr_scale = 0.25        # as if a sentinel backed off
+    tr2.round_counter = 5
+    model_dir = str(tmp_path / "models")
+    os.makedirs(model_dir)
+    tr2.save_model(ckpt.model_path(model_dir, 5))
+
+    led = str(tmp_path / "led.jsonl")
+    LEDGER.enable(led, "test-resume", host=0)
+    try:
+        tr1 = Trainer(cfg, mesh_ctx=make_mesh_context(
+            devices=jax.devices()[:1]))
+        r = resume_latest(tr1, model_dir, silent=True)
+        assert r == 5
+        assert tr1._step_count == 3 and tr1.optimizer.lr_scale == 0.25
+        for a, b in zip(jax.tree_util.tree_leaves(
+                            ckpt.jax_to_numpy(tr2.mesh.gather(tr2.params))),
+                        jax.tree_util.tree_leaves(
+                            ckpt.jax_to_numpy(tr1.params))):
+            assert np.array_equal(a, b)
+        for a, b in zip(jax.tree_util.tree_leaves(
+                            ckpt.jax_to_numpy(tr2.mesh.gather(tr2.opt_state))),
+                        jax.tree_util.tree_leaves(
+                            ckpt.jax_to_numpy(tr1.opt_state))):
+            assert np.array_equal(a, b)
+        ev = [e for e in read_ledger(led)
+              if e["event"] == "elastic_resume"]
+        assert ev and ev[0]["round"] == 5 and ev[0]["dp"] == 1 \
+            and ev[0]["step_count"] == 3
+    finally:
+        LEDGER.disable()
+    # empty dir: no checkpoint -> None (caller inits fresh)
+    assert resume_latest(Trainer(cfg), str(tmp_path / "empty"),
+                         silent=True) is None
+
+
+def test_resume_trajectory_bit_exact_same_width(tmp_path):
+    """Resume at the SAME width replays the identical step sequence:
+    train 2+3 steps across a save/restore boundary == 5 straight steps
+    (rng stream + optimizer state + schedules all carried)."""
+    cfg = parse_config_string(NET_CFG)
+    ref = Trainer(cfg, mesh_ctx=make_mesh_context(devices=jax.devices()[:1]))
+    ref.init_model()
+    _train_steps(ref, 5)
+    ref_params = ckpt.jax_to_numpy(ref.params)
+
+    a = Trainer(cfg, mesh_ctx=make_mesh_context(devices=jax.devices()[:1]))
+    a.init_model()
+    _train_steps(a, 2)
+    model_dir = str(tmp_path / "m2")
+    os.makedirs(model_dir)
+    a.save_model(ckpt.model_path(model_dir, 0))
+    b = Trainer(cfg, mesh_ctx=make_mesh_context(devices=jax.devices()[:1]))
+    assert resume_latest(b, model_dir, silent=True) == 0
+    # the data stream is position-keyed the same way (fresh RandomState
+    # per call here; steps 3..5 use the same draws in both runs)
+    rng = np.random.RandomState(0)
+    from cxxnet_tpu.io.data import DataBatch
+    for _ in range(2):      # skip the 2 already-trained draws
+        rng.randn(8, 1, 1, 8)
+        rng.randint(0, 4, (8, 1))
+    for _ in range(3):
+        b.update(DataBatch(
+            data=rng.randn(8, 1, 1, 8).astype(np.float32),
+            label=rng.randint(0, 4, (8, 1)).astype(np.float32)))
+    for x, y in zip(jax.tree_util.tree_leaves(ref_params),
+                    jax.tree_util.tree_leaves(ckpt.jax_to_numpy(b.params))):
+        assert np.array_equal(x, y)
+
+
+def test_carry_trainer_state_in_memory(tmp_path):
+    """The DCN-mode in-memory handoff: dp=4 -> dp=2 without a
+    checkpoint round-trip, bit-equal state + counters."""
+    cfg = parse_config_string(NET_CFG)
+    src = Trainer(cfg, mesh_ctx=make_mesh_context(devices=jax.devices()[:4]))
+    src.init_model()
+    _train_steps(src, 2)
+    src.optimizer.lr_scale = 0.5
+    dst = Trainer(cfg, mesh_ctx=make_mesh_context(devices=jax.devices()[:2]))
+    carry_trainer_state(src, dst)
+    assert dst._step_count == 2 and dst.optimizer.lr_scale == 0.5
+    for a, b in zip(jax.tree_util.tree_leaves(
+                        ckpt.jax_to_numpy(src.mesh.gather(src.params))),
+                    jax.tree_util.tree_leaves(ckpt.jax_to_numpy(dst.params))):
+        assert np.array_equal(a, b)
+    for a, b in zip(jax.tree_util.tree_leaves(
+                        ckpt.jax_to_numpy(src.mesh.gather(src.opt_state))),
+                    jax.tree_util.tree_leaves(
+                        ckpt.jax_to_numpy(dst.opt_state))):
+        assert np.array_equal(a, b)
+    # and the carried trainer can actually step at the new width
+    _train_steps(dst, 1)
+    assert np.isfinite(dst.last_loss)
+
+
+# -- task driver: budgeted stints vs completion ----------------------------
+
+def test_elastic_task_respects_max_round(tmp_path):
+    """A stint capped by max_round below num_round is a budgeted exit,
+    not completion: the generation record must NOT be marked complete
+    (a later worker continues the run), and an uncapped rerun finishes
+    and marks it."""
+    from cxxnet_tpu.main import LearnTask
+    cfg_str = """
+data = train
+iter = synthetic
+  num_inst = 64
+  num_class = 4
+  input_shape = 1,1,8
+  seed_data = 3
+iter = end
+""" + NET_CFG + """
+num_round = 4
+max_round = %(max_round)s
+dev = cpu
+print_step = 0
+silent = 1
+save_period = 1
+model_dir = %(td)s/models
+elastic_dir = %(td)s/elastic
+elastic_heartbeat_s = 0.5
+elastic_worker = 0
+"""
+    # checkpoints are the handoff medium: save_model=0 AND
+    # save_period=0 are both rejected
+    with pytest.raises(ValueError, match="save_model"):
+        LearnTask(parse_config_string(
+            cfg_str % dict(max_round=2, td=tmp_path)
+            + "save_model = 0\n")).run()
+    with pytest.raises(ValueError, match="save_period"):
+        LearnTask(parse_config_string(
+            (cfg_str % dict(max_round=2, td=tmp_path)).replace(
+                "save_period = 1", "save_period = 0"))).run()
+    LearnTask(parse_config_string(
+        cfg_str % dict(max_round=2, td=tmp_path))).run()
+    gen = json.load(open(tmp_path / "elastic" / "generation.json"))
+    assert not gen.get("complete"), \
+        "a max_round-capped stint must not mark the run complete"
+    assert os.path.exists(tmp_path / "models" / "0001.model")
+    assert not os.path.exists(tmp_path / "models" / "0003.model")
+    # an uncapped worker picks the run back up and completes it
+    LearnTask(parse_config_string(
+        cfg_str % dict(max_round=0, td=tmp_path))).run()
+    gen = json.load(open(tmp_path / "elastic" / "generation.json"))
+    assert gen.get("complete")
+    assert os.path.exists(tmp_path / "models" / "0003.model")
+    # reusing the same elastic_dir with MORE rounds must REOPEN the
+    # stale completion marker, not silently exit 0 untrained
+    LearnTask(parse_config_string(
+        (cfg_str % dict(max_round=0, td=tmp_path)).replace(
+            "num_round = 4", "num_round = 6"))).run()
+    gen = json.load(open(tmp_path / "elastic" / "generation.json"))
+    assert gen.get("complete")
+    assert os.path.exists(tmp_path / "models" / "0005.model")
+
+
+# -- report timeline -------------------------------------------------------
+
+@pytest.mark.quick
+def test_report_topology_timeline(tmp_path):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "report", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "report.py"))
+    report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(report)
+    led = tmp_path / "led.jsonl"
+    events = [
+        {"schema": 1, "ts": 1.0, "run_id": "r", "host": 0,
+         "event": "elastic_join", "worker": 0, "capacity": 2, "pid": 1},
+        {"schema": 1, "ts": 2.0, "run_id": "r", "host": 0,
+         "event": "topology_change", "gen": 1, "members": [0],
+         "leader": 0, "width": 2, "reason": "init"},
+        {"schema": 1, "ts": 3.0, "run_id": "r", "host": 1,
+         "event": "topology_change", "gen": 2, "members": [1],
+         "leader": 1, "width": 1, "reason": "lost:0"},
+        {"schema": 1, "ts": 4.0, "run_id": "r", "host": 1,
+         "event": "elastic_resume", "round": 3, "dp": 1,
+         "step_count": 24},
+        {"schema": 1, "ts": 5.0, "run_id": "r", "host": 0,
+         "event": "elastic_advice", "worker": 1, "action": "demote",
+         "ratio": 3.0},
+        {"schema": 1, "ts": 6.0, "run_id": "r", "host": 1,
+         "event": "elastic_leave", "worker": 1, "reason": "preempt"},
+    ]
+    with open(led, "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    md = report.generate(str(led), None, [])
+    assert "## Topology timeline" in md
+    for needle in ("gen 1 (init)", "gen 2 (lost:0)", "dp width "
+                   "trajectory: 2 -> 1", "round 3 onto dp=1",
+                   "demote worker 1", "worker 1 (preempt)"):
+        assert needle in md, (needle, md)
+    # elastic events must NOT double-render in the incident timeline
+    assert "**elastic_join**:" not in md.split("## Topology")[0]

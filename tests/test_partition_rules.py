@@ -284,6 +284,100 @@ def test_reshard_roundtrip_opt_state_across_dp_widths():
         assert a.dtype == b.dtype and np.array_equal(a, b)
 
 
+def test_reshard_roundtrip_dp_4_2_4_with_fp16_scaler_and_meta():
+    """Satellite (ISSUE 10): the elastic reshard round-trip must cover
+    the WHOLE training state, not just plain param/opt leaves — the
+    fp16 loss-scaler subtree (``opt_state["_mp"]``: fp32 scale + int32
+    clean-step counter) rides the reshard across dp 4 -> 2 -> 4
+    bit-exactly, and the checkpoint meta's ``lr_scale``/``step_count``
+    survive a cross-width save/restore."""
+    import tempfile
+
+    from cxxnet_tpu import checkpoint as ckpt
+    from cxxnet_tpu.elastic import reshard_tree
+    from cxxnet_tpu.trainer import Trainer
+
+    fp16 = [("compute_dtype", "float16")]
+    cfg = parse_config_string(LM_CFG) + fp16
+    net = Network(build_graph(cfg), cfg)
+    params, _ = net.init(jax.random.PRNGKey(0))
+    opt = create_optimizer("adam", cfg)
+    assert opt.fp16
+    state = opt.init_state(params)
+    assert "_mp" in state
+    # recognizable, non-default scaler state: a round-trip that
+    # silently re-inits the subtree would be caught
+    state["_mp"] = {"scale": jnp.float32(1024.0),
+                    "good": jnp.int32(37)}
+    host0 = jax.tree_util.tree_map(np.asarray, state)
+
+    def specs_for(ctx, width):
+        shapes = jax.eval_shape(lambda: state)
+        base = match_partition_rules(net.partition_rules(), shapes)
+        return add_fsdp(base, shapes, "data", width, min_size=16)
+
+    ctx4 = make_mesh_context(devices=jax.devices()[:4])
+    ctx2 = make_mesh_context(devices=jax.devices()[:2])
+    # scalars ("_mp", "t") must spec as replicated P() via the scalar
+    # rule — never partitioned
+    s4 = specs_for(ctx4, 4)
+    assert tuple(s4["_mp"]["scale"]) == () and tuple(s4["t"]) == ()
+    mid = reshard_tree(state, ctx4, ctx2, s4, specs_for(ctx2, 2))
+    # at least one big leaf is genuinely dp-sharded at each width
+    assert not mid["m1"]["attn1"]["q"]["wmat"].sharding \
+        .is_fully_replicated
+    back = reshard_tree(mid, ctx2, ctx4, specs_for(ctx2, 2), s4)
+    flat0, _ = jax.tree_util.tree_flatten(host0)
+    flat4, _ = jax.tree_util.tree_flatten(
+        jax.tree_util.tree_map(np.asarray, ctx4.gather(back)))
+    for a, b in zip(flat0, flat4):
+        assert a.dtype == b.dtype and np.array_equal(a, b)
+
+    # cross-width checkpoint: save from a dp=4 fp16 trainer, restore
+    # onto dp=2 — _mp, lr_scale and step_count all carried
+    tr_cfg = parse_config_string("""
+netconfig=start
+layer[0->1] = fullc:fc_big
+  nhidden = 64
+  init_sigma = 0.01
+layer[1->2] = relu:r1
+layer[2->3] = fullc:fc_out
+  nhidden = 4
+  init_sigma = 0.01
+layer[3->3] = softmax
+netconfig=end
+input_shape = 1,1,32
+batch_size = 8
+eta = 0.1
+eval_train = 0
+compute_dtype = float16
+""")
+    from cxxnet_tpu.io.data import DataBatch
+    tr4 = Trainer(tr_cfg, mesh_ctx=ctx4)
+    tr4.init_model()
+    rng = np.random.RandomState(0)
+    for _ in range(3):
+        tr4.update(DataBatch(
+            data=rng.randn(8, 1, 1, 32).astype(np.float32),
+            label=rng.randint(0, 4, (8, 1)).astype(np.float32)))
+    tr4.optimizer.lr_scale = 0.125
+    with tempfile.TemporaryDirectory() as td:
+        path = ckpt.model_path(td, 0)
+        tr4.save_model(path)
+        tr2 = Trainer(tr_cfg, mesh_ctx=ctx2)
+        tr2.load_model(path)
+    assert tr2._step_count == 3
+    assert tr2.optimizer.lr_scale == 0.125
+    mp4 = jax.tree_util.tree_map(np.asarray, tr4.opt_state["_mp"])
+    mp2 = jax.tree_util.tree_map(np.asarray, tr2.opt_state["_mp"])
+    assert mp4["scale"] == mp2["scale"] and mp4["good"] == mp2["good"]
+    for a, b in zip(
+            jax.tree_util.tree_leaves(ckpt.jax_to_numpy(
+                tr4.mesh.gather(tr4.opt_state))),
+            jax.tree_util.tree_leaves(ckpt.jax_to_numpy(tr2.opt_state))):
+        assert np.array_equal(a, b)
+
+
 def test_fsdp_trainer_placement_and_parity():
     """fsdp_axis = data: params + optimizer state shard at rest over
     the data axis on the std path, and the 2-step trajectory matches

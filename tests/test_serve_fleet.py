@@ -464,6 +464,37 @@ def test_sigterm_triggers_graceful_drain(mesh1):
     assert signal.getsignal(signal.SIGTERM) is not handler
 
 
+def test_sigterm_chains_to_previous_handler(mesh1):
+    """Satellite regression (ISSUE 10): when train+serve share a
+    process, ServeServer.start()'s SIGTERM handler must CHAIN to the
+    handler installed before it (e.g. the elastic preemption handler),
+    not clobber it — one signal, both concerns."""
+    import threading
+    if threading.current_thread() is not threading.main_thread():
+        pytest.skip("signal installs are main-thread-only")
+    seen = []
+    orig = signal.signal(signal.SIGTERM, lambda s, f: seen.append("prev"))
+    tr = Trainer(parse_config_string(NET_CFG), mesh_ctx=mesh1)
+    tr.init_model()
+    eng = InferenceEngine(tr, buckets="2,4,8", max_batch=8)
+    srv = ServeServer(eng, port=0, log_interval_s=0, silent=True)
+    try:
+        srv.start()
+        handler = signal.getsignal(signal.SIGTERM)
+        assert callable(handler)
+        handler(signal.SIGTERM, None)
+        assert seen == ["prev"], \
+            "serve's handler must invoke the previously installed one"
+        deadline = time.time() + 10
+        while not srv._stopped and time.time() < deadline:
+            time.sleep(0.05)
+        assert srv._stopped, "serve's own drain must still run"
+    finally:
+        srv.stop()
+        srv._restore_signal_handlers()
+        signal.signal(signal.SIGTERM, orig)
+
+
 def test_single_engine_version_pin(mesh1):
     tr = Trainer(parse_config_string(NET_CFG), mesh_ctx=mesh1)
     tr.init_model()

@@ -11,6 +11,9 @@ happened to this run" without grepping logs —
     hang dumps (stack excerpt), stragglers, recompile storms;
   * serving timeline: fleet bring-up, hot weight reloads (old/new
     round + digest), replica lifecycle transitions;
+  * topology timeline: elastic joins/leaves, generation bumps with
+    membership/leader/dp width, topology-change resumes, demotion
+    advisories (doc/elastic_runbook.md);
   * checkpoint activity (saves/loads, failures, IO seconds);
   * step-time + fleet metrics from the LAST telemetry_log snapshot
     (EMAs, per-host straggler ratios, hang/compile counters);
@@ -155,7 +158,10 @@ def section_incidents(events: List[Dict], out: List[str]) -> None:
                  ("round_end", "compile", "ckpt_save", "ckpt_load",
                   "run_start", "run_end",
                   # serving lifecycle renders in its own timeline
-                  "serve_start", "weights_reload", "replica_state")]
+                  "serve_start", "weights_reload", "replica_state",
+                  # elastic lifecycle renders in the topology timeline
+                  "elastic_join", "elastic_leave", "topology_change",
+                  "elastic_resume", "elastic_advice")]
     if not incidents:
         out.append("No incidents recorded — clean run.")
         out.append("")
@@ -249,6 +255,56 @@ def section_serving(events: List[Dict], out: List[str]) -> None:
                            if isinstance(e.get("new_round"), int)})
         out.append("%d replica weight swap(s); versions served: %s"
                    % (len(reloads), ", ".join(versions) or "?"))
+        out.append("")
+
+
+_ELASTIC_EVENTS = ("elastic_join", "elastic_leave", "topology_change",
+                   "elastic_resume", "elastic_advice")
+
+
+def section_topology(events: List[Dict], out: List[str]) -> None:
+    """Topology timeline: who joined/left when, every generation bump
+    with its membership/leader/width, every topology-change resume
+    (round + dp width it restored onto), and straggler-demotion
+    advisories — the ROADMAP-4 runbook's "what the ledger shows" view
+    of an elastic run (doc/elastic_runbook.md)."""
+    elastic = [e for e in events if e.get("event") in _ELASTIC_EVENTS]
+    if not elastic:
+        return
+    out.append("## Topology timeline")
+    out.append("")
+    for e in elastic[:200]:
+        etype = e.get("event")
+        line = "- %s `h%s` **%s**" % (_ts(e.get("ts")),
+                                      e.get("host", 0), etype)
+        if etype == "elastic_join":
+            line += ": worker %s (capacity %s, pid %s)" % (
+                e.get("worker", "?"), e.get("capacity", "?"),
+                e.get("pid", "?"))
+        elif etype == "elastic_leave":
+            line += ": worker %s (%s)" % (e.get("worker", "?"),
+                                          e.get("reason", "?"))
+        elif etype == "topology_change":
+            line += ": gen %s (%s) members %s, leader %s, dp width %s" \
+                % (e.get("gen", "?"), e.get("reason", "?"),
+                   e.get("members", "?"), e.get("leader", "?"),
+                   e.get("width", "?"))
+        elif etype == "elastic_resume":
+            line += ": round %s onto dp=%s (step_count %s%s)" % (
+                e.get("round", "?"), e.get("dp", "?"),
+                e.get("step_count", "?"),
+                ", in-memory" if e.get("in_memory") else "")
+        elif etype == "elastic_advice":
+            line += ": %s worker %s (%sx fleet median)" % (
+                e.get("action", "?"), e.get("worker", "?"),
+                e.get("ratio", "?"))
+        out.append(line)
+    out.append("")
+    gens = [e for e in elastic if e.get("event") == "topology_change"]
+    if gens:
+        widths = [str(e.get("width", "?")) for e in gens]
+        out.append("%d generation(s); dp width trajectory: %s"
+                   % (len(gens), " -> ".join(widths)))
         out.append("")
 
 
@@ -369,6 +425,7 @@ def generate(ledger_path: str, telemetry_log: Optional[str],
     section_rounds(events, out)
     section_incidents(events, out)
     section_serving(events, out)
+    section_topology(events, out)
     section_checkpoints(events, out)
     section_telemetry(snap, out)
     section_bench(bench_paths, out)
