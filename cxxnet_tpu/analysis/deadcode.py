@@ -1,0 +1,145 @@
+"""dead-symbol: module-level functions/classes nothing references.
+
+Dead code in a conventions-enforced codebase is worse than clutter: it
+keeps compiling against old invariants and gets cargo-culted back into
+live paths. This pass reports module-level ``def`` / ``class`` symbols
+in ``cxxnet_tpu/`` that no scanned file references.
+
+What counts as a reference (name-level, deliberately conservative —
+a false "dead" claim costs more than a missed one):
+
+* any ``Name`` load of the symbol's name, anywhere in any scanned or
+  context module (tools/, tests/, bench.py, examples/ all count),
+* any attribute access ``x.<name>`` (cross-module calls),
+* any ``from m import <name>`` / ``import`` alias,
+* recursion does NOT count: references inside the symbol's own span
+  in its own module are excluded.
+
+Exempt: names exported by any ``__init__.py`` (public API is allowed
+to wait for external users), ``__all__`` entries, dunder names, and
+symbols carrying a ``@register_*`` decorator (the layer/iterator
+registries reach them through string keys, not names — the decorator
+side effect IS the reference).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from .core import Finding, LintPass, ModuleInfo, Project, const_str
+
+_FN = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _module_symbols(mod: ModuleInfo) -> List[Tuple[ast.AST, int, int]]:
+    """(node, span_start, span_end) for top-level defs/classes,
+    including ones nested in top-level try/if blocks (version-gated
+    definitions are still module-level API)."""
+    out = []
+
+    def visit(stmts):
+        for s in stmts:
+            if isinstance(s, _FN + (ast.ClassDef,)):
+                start = min([s.lineno]
+                            + [d.lineno for d in s.decorator_list])
+                out.append((s, start, s.end_lineno or s.lineno))
+            elif isinstance(s, (ast.If, ast.Try)):
+                visit(getattr(s, "body", []))
+                visit(getattr(s, "orelse", []))
+                for h in getattr(s, "handlers", []):
+                    visit(h.body)
+                visit(getattr(s, "finalbody", []))
+
+    visit(mod.tree.body if mod.tree else [])
+    return out
+
+
+def _references(mod: ModuleInfo) -> List[Tuple[str, int]]:
+    """(name, line) for every name-level reference in a module."""
+    refs = []
+    for n in ast.walk(mod.tree):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+            refs.append((n.id, n.lineno))
+        elif isinstance(n, ast.Attribute):
+            refs.append((n.attr, n.lineno))
+        elif isinstance(n, ast.ImportFrom):
+            for a in n.names:
+                refs.append((a.name, n.lineno))
+        elif isinstance(n, ast.Assign):
+            # __all__ string entries are references (and exports)
+            for t in n.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__":
+                    for e in ast.walk(n.value):
+                        s = const_str(e)
+                        if s:
+                            refs.append((s, n.lineno))
+    return refs
+
+
+class DeadSymbolPass(LintPass):
+    name = "dead-symbol"
+    description = ("module-level functions/classes in cxxnet_tpu/ that "
+                   "nothing in the scanned tree references")
+
+    def run(self, project: Project) -> List[Finding]:
+        # reference index over EVERYTHING (lint targets + context)
+        refs_by_mod: Dict[str, List[Tuple[str, int]]] = {}
+        exported: Set[str] = set()
+        for mod in project.all_modules:
+            if mod.tree is None:
+                continue
+            refs_by_mod[mod.rel] = _references(mod)
+            if mod.rel.replace("\\", "/").endswith("__init__.py"):
+                for n in ast.walk(mod.tree):
+                    if isinstance(n, ast.ImportFrom):
+                        exported.update(a.asname or a.name
+                                        for a in n.names)
+
+        all_names: Dict[str, List[Tuple[str, int]]] = {}
+        for rel, refs in refs_by_mod.items():
+            for name, line in refs:
+                all_names.setdefault(name, []).append((rel, line))
+
+        out: List[Finding] = []
+        for mod in project.modules:
+            rel = mod.rel.replace("\\", "/")
+            if mod.tree is None or not rel.startswith("cxxnet_tpu/") \
+                    or rel.endswith("__init__.py"):
+                continue
+            for node, start, end in _module_symbols(mod):
+                name = node.name
+                if name.startswith("__") or name in exported:
+                    continue
+                if self._registered(node):
+                    continue
+                used = any(
+                    r_rel != mod.rel or not (start <= r_line <= end)
+                    for r_rel, r_line in all_names.get(name, []))
+                if not used:
+                    kind = ("class" if isinstance(node, ast.ClassDef)
+                            else "function")
+                    out.append(Finding(
+                        self.name, mod.rel, node.lineno,
+                        node.col_offset,
+                        f"module-level {kind} '{name}' is never "
+                        "referenced across the scanned tree — delete "
+                        "it (or export it from an __init__ if it is "
+                        "public API)", mod.line_text(node.lineno)))
+        return out
+
+    @staticmethod
+    def _registered(node: ast.AST) -> bool:
+        """Registry decorators (@register_layer("fullc"), …) publish
+        the symbol under a string key — alive by construction."""
+        for dec in getattr(node, "decorator_list", []):
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            if isinstance(target, ast.Attribute):
+                leaf = target.attr
+            elif isinstance(target, ast.Name):
+                leaf = target.id
+            else:
+                continue
+            if leaf.startswith("register"):
+                return True
+        return False

@@ -1,0 +1,111 @@
+"""atomic-io: durable state goes through ``io.stream.write_bytes_atomic``.
+
+The PR-3/10 invariant: anything another process (or a post-crash
+restart) reads as *state of record* — checkpoints, the run ledger, the
+elastic coordinator's membership/generation files, fleet metric
+snapshots — must be written tmp + fsync + rename (+ dir fsync) via
+``write_bytes_atomic``, never by a raw ``open(path, "w")`` or a bare
+``os.rename``: an unfsynced rename can surface after a power cut as
+the new name holding truncated bytes, and a torn in-place write is a
+reader's problem forever. The one sanctioned exception is the ledger's
+O_APPEND protocol (telemetry/ledger.py): single sub-PIPE_BUF
+``open(path, "a")`` + one ``write()`` per line is atomic by POSIX and
+is the only way several processes can share one file.
+
+Scope: the durable-path modules listed in ``DURABLE_MODULES`` below.
+Data-plane writers (recordio packers, pred outputs, trace dumps) are
+deliberately out of scope — they are rewritable products, not state of
+record.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from .core import (Finding, LintPass, Project, call_chain,
+                   canonical_chain, const_str, import_aliases)
+
+#: repo-relative files/prefixes holding durable state of record; a
+#: trailing '/' marks a package prefix
+DURABLE_MODULES = (
+    "cxxnet_tpu/checkpoint.py",
+    "cxxnet_tpu/telemetry/ledger.py",
+    "cxxnet_tpu/telemetry/aggregate.py",   # fleet snapshot transport
+    "cxxnet_tpu/elastic/",
+)
+
+#: modules whose append-mode opens implement the sanctioned O_APPEND
+#: line protocol
+APPEND_PROTOCOL_MODULES = ("cxxnet_tpu/telemetry/ledger.py",)
+
+
+def is_durable(rel: str) -> bool:
+    rel = rel.replace("\\", "/")
+    return any(rel == d or (d.endswith("/") and rel.startswith(d))
+               for d in DURABLE_MODULES)
+
+
+def _open_mode(call: ast.Call) -> Optional[str]:
+    """The mode of an open()/sopen() call, when statically known."""
+    if len(call.args) >= 2:
+        return const_str(call.args[1])
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            return const_str(kw.value)
+    return "r"          # open(path) defaults to read
+
+
+class AtomicIoPass(LintPass):
+    name = "atomic-io"
+    description = ("raw writes / bare renames on durable paths that "
+                   "bypass io.stream.write_bytes_atomic")
+
+    def run(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in project.modules:
+            if mod.tree is None or not is_durable(mod.rel):
+                continue
+            aliases = import_aliases(mod.tree)
+            append_ok = mod.rel.replace("\\", "/") \
+                in APPEND_PROTOCOL_MODULES
+            for n in ast.walk(mod.tree):
+                if not isinstance(n, ast.Call):
+                    continue
+                chain = canonical_chain(call_chain(n), aliases)
+                last = chain.rsplit(".", 1)[-1]
+                msg = None
+                if chain in ("open", "io.open") or last == "sopen":
+                    mode = _open_mode(n)
+                    if mode is None:
+                        msg = (f"{last}() with a dynamic mode on a "
+                               "durable path — route writes through "
+                               "io.stream.write_bytes_atomic")
+                    elif any(c in mode for c in "wx+"):
+                        msg = (f"raw {last}(..., {mode!r}) on a durable "
+                               "path — use io.stream.write_bytes_atomic "
+                               "(tmp+fsync+rename) so a crash never "
+                               "leaves a torn file")
+                    elif "a" in mode and not append_ok:
+                        msg = (f"append-mode {last}() outside the "
+                               "ledger's O_APPEND protocol — durable "
+                               "appends belong in telemetry/ledger.py")
+                elif chain in ("os.rename", "os.replace"):
+                    msg = (f"bare {chain}() on a durable path — "
+                           "write_bytes_atomic owns the tmp+fsync+"
+                           "rename protocol (incl. directory fsync)")
+                elif chain == "os.open":
+                    flags = ast.dump(ast.Module(body=[ast.Expr(a)
+                                                      for a in n.args],
+                                                type_ignores=[]))
+                    writes = any(f in flags for f in
+                                 ("O_WRONLY", "O_RDWR", "O_CREAT"))
+                    if writes and "O_APPEND" not in flags:
+                        msg = ("os.open() write without O_APPEND on a "
+                               "durable path — use write_bytes_atomic "
+                               "or the ledger's append protocol")
+                if msg:
+                    out.append(Finding(
+                        self.name, mod.rel, n.lineno, n.col_offset,
+                        msg, mod.line_text(n.lineno)))
+        return out

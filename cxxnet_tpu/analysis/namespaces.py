@@ -1,0 +1,148 @@
+"""config-namespace: namespaced config keys must be declared.
+
+The codebase's validated-namespace contract (config.py): every
+``serve_*`` / ``telemetry_*`` / ``elastic_*`` / ``io_retry_*`` /
+``fsdp_*`` key is declared in a ``parse_*`` validator's ``known``
+table, so a typo'd key raises at parse time instead of silently
+running with defaults. That protects *writers* of configs — but a
+typo'd key string at a READ site (``cfg.get("serve_relaods")``) still
+returns a default forever, because nothing cross-checks read sites
+against the declared tables.
+
+This pass closes the loop mechanically:
+
+* **declared keys** are harvested from the project itself — every
+  string key of a ``known = {...}`` / ``known = {...set...}``
+  assignment inside any ``parse_*`` function (so adding a key to
+  config.py updates the lint automatically);
+* **read sites** are string literals with a namespace prefix used as a
+  dict subscript, as the first argument of ``.get`` / ``.pop`` /
+  ``.setdefault``, or in an ``==`` / ``in`` comparison;
+* exemptions: ledger event names (harvested from ``KNOWN_EVENTS``
+  assignments — ``elastic_join`` is an event, not a config key), the
+  bare prefixes themselves (``name.startswith("serve_")``), and
+  literals inside ``with pytest.raises(...)`` blocks (tests that
+  *prove* the typo raises are using bad keys on purpose).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from .core import (Finding, LintPass, Project, build_parents,
+                   call_chain, const_str)
+
+#: the validated config namespaces (doc/tasks.md; config.py owns the
+#: declarations, this is only the prefix filter)
+NAMESPACE_PREFIXES = ("serve_", "telemetry_", "elastic_", "io_retry_",
+                      "fsdp_")
+
+_FN = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _harvest(project: Project) -> Tuple[Set[str], Set[str]]:
+    """(declared config keys, exempt event-name strings) across the
+    whole project including context modules."""
+    declared: Set[str] = set()
+    events: Set[str] = set()
+    for mod in project.all_modules:
+        if mod.tree is None:
+            continue
+        for fn in ast.walk(mod.tree):
+            if not (isinstance(fn, _FN) and fn.name.startswith("parse_")):
+                continue
+            for n in ast.walk(fn):
+                if not (isinstance(n, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == "known"
+                        for t in n.targets)):
+                    continue
+                v = n.value
+                elts = []
+                if isinstance(v, ast.Dict):
+                    elts = v.keys
+                elif isinstance(v, (ast.Set, ast.List, ast.Tuple)):
+                    elts = v.elts
+                elif isinstance(v, ast.Call) and call_chain(v) == "set":
+                    if v.args and isinstance(v.args[0],
+                                             (ast.List, ast.Tuple,
+                                              ast.Set)):
+                        elts = v.args[0].elts
+                for e in elts:
+                    s = const_str(e)
+                    if s:
+                        declared.add(s)
+        for n in ast.walk(mod.tree):
+            if isinstance(n, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "KNOWN_EVENTS"
+                    for t in n.targets) \
+                    and isinstance(n.value, (ast.Tuple, ast.List)):
+                for e in n.value.elts:
+                    s = const_str(e)
+                    if s:
+                        events.add(s)
+    return declared, events
+
+
+class ConfigNamespacePass(LintPass):
+    name = "config-namespace"
+    description = ("namespaced config-key string at a read site that "
+                   "no parse_* validator declares (typo?)")
+
+    def run(self, project: Project) -> List[Finding]:
+        declared, events = _harvest(project)
+        if not declared:
+            return []          # fixture project without a config module
+        out: List[Finding] = []
+        for mod in project.modules:
+            if mod.tree is None:
+                continue
+            raises_spans = self._raises_spans(mod.tree)
+            parents = build_parents(mod.tree)
+            for n in ast.walk(mod.tree):
+                s = const_str(n)
+                if s is None or s in declared or s in events \
+                        or s in NAMESPACE_PREFIXES:
+                    continue
+                if not any(s.startswith(p) for p in NAMESPACE_PREFIXES):
+                    continue
+                if not self._is_read_site(n, parents):
+                    continue
+                if any(a <= n.lineno <= b for a, b in raises_spans):
+                    continue
+                out.append(Finding(
+                    self.name, mod.rel, n.lineno, n.col_offset,
+                    f"config key {s!r} is not declared in any parse_* "
+                    "validator namespace — a typo here silently reads "
+                    "the default forever (declare it in config.py or "
+                    "fix the spelling)", mod.line_text(n.lineno)))
+        return out
+
+    @staticmethod
+    def _raises_spans(tree: ast.AST) -> List[Tuple[int, int]]:
+        spans = []
+        for n in ast.walk(tree):
+            if isinstance(n, (ast.With, ast.AsyncWith)):
+                for item in n.items:
+                    ce = item.context_expr
+                    if isinstance(ce, ast.Call) \
+                            and call_chain(ce).endswith("raises"):
+                        spans.append((n.lineno, n.end_lineno or n.lineno))
+        return spans
+
+    @staticmethod
+    def _is_read_site(n: ast.AST, parents: Dict[int, ast.AST]) -> bool:
+        p = parents.get(id(n))
+        if isinstance(p, ast.Subscript) and p.slice is n:
+            return True
+        if isinstance(p, ast.Call) and p.args and p.args[0] is n \
+                and isinstance(p.func, ast.Attribute) \
+                and p.func.attr in ("get", "pop", "setdefault"):
+            return True
+        if isinstance(p, ast.Compare):
+            return True
+        if isinstance(p, (ast.Tuple, ast.List, ast.Set)):
+            gp = parents.get(id(p))
+            if isinstance(gp, ast.Compare) and p in gp.comparators:
+                return True
+        return False

@@ -1,0 +1,114 @@
+"""signal-safety: handlers only set events/flags.
+
+The PR-8/10 rule: a Python signal handler runs between two arbitrary
+bytecodes on the main thread. Anything beyond setting an
+``threading.Event`` / flipping a flag is a reentrancy hazard — taking
+a lock can deadlock against the interrupted holder, file IO can tear
+buffers, and resolving the previous handler via ``signal.getsignal``
+*inside* the handler races later installers (the bind-at-install
+rule: serve/server.py binds ``chain_signal_handler`` and the saved
+previous handler at install time, and the handler body only sets the
+drain event and calls the pre-bound chain).
+
+Detection is lexical over the handler function's body (nested defs
+included): any function (or lambda) passed as the second argument of
+``signal.signal(...)`` is a handler.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from .core import (Finding, LintPass, Project, call_chain,
+                   canonical_chain, import_aliases)
+
+_FN = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+#: call chains that are file IO / blocking no matter the receiver
+_IO_CHAINS = {
+    "open", "os.open", "os.write", "os.remove", "os.replace",
+    "os.rename", "os.makedirs", "os.fsync", "print",
+}
+_IO_LASTS = {"sopen", "write_bytes_atomic"}
+_BLOCKING_ATTRS = {"acquire", "join"}
+
+
+class SignalSafetyPass(LintPass):
+    name = "signal-safety"
+    description = ("signal handlers doing more than setting events/"
+                   "flags (locks, file IO, chaining resolved in-handler)")
+
+    def run(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in project.modules:
+            if mod.tree is None:
+                continue
+            aliases = import_aliases(mod.tree)
+            defs_by_name = {}
+            for n in ast.walk(mod.tree):
+                if isinstance(n, _FN):
+                    defs_by_name.setdefault(n.name, []).append(n)
+
+            handlers = []
+            for n in ast.walk(mod.tree):
+                if not isinstance(n, ast.Call) or len(n.args) < 2:
+                    continue
+                chain = canonical_chain(call_chain(n), aliases)
+                if chain != "signal.signal" \
+                        and not chain.endswith(".signal.signal"):
+                    continue
+                h = n.args[1]
+                if isinstance(h, ast.Lambda):
+                    handlers.append(h)
+                elif isinstance(h, ast.Name):
+                    handlers.extend(defs_by_name.get(h.id, []))
+
+            seen = set()
+            for h in handlers:
+                if id(h) in seen:
+                    continue
+                seen.add(id(h))
+                hname = getattr(h, "name", "<lambda>")
+                for n in ast.walk(h):
+                    msg = self._violation(n, aliases, hname)
+                    if msg:
+                        out.append(Finding(
+                            self.name, mod.rel, n.lineno, n.col_offset,
+                            msg, mod.line_text(n.lineno)))
+        return out
+
+    def _violation(self, n: ast.AST, aliases, hname: str
+                   ) -> Optional[str]:
+        if isinstance(n, (ast.With, ast.AsyncWith)):
+            return (f"context manager inside signal handler '{hname}' "
+                    "— a lock taken here can deadlock against the "
+                    "interrupted holder; set an event and do the work "
+                    "on a watcher thread")
+        if not isinstance(n, ast.Call):
+            return None
+        chain = canonical_chain(call_chain(n), aliases)
+        last = chain.rsplit(".", 1)[-1]
+        if chain == "signal.getsignal":
+            return (f"signal.getsignal() inside handler '{hname}' — "
+                    "resolve the chain at INSTALL time (bind-at-"
+                    "install rule, elastic/preempt.py), never in the "
+                    "handler")
+        if chain == "signal.signal":
+            return (f"signal.signal() inside handler '{hname}' — "
+                    "(re)installing handlers from signal context races "
+                    "other installers; do it on the watcher thread "
+                    "path")
+        if chain in _IO_CHAINS or last in _IO_LASTS:
+            return (f"{chain or last}() inside signal handler "
+                    f"'{hname}' — handlers only set events/flags; "
+                    "move IO to the thread that polls the event")
+        if chain == "time.sleep":
+            return (f"time.sleep() inside signal handler '{hname}' — "
+                    "handlers must return immediately")
+        if isinstance(n.func, ast.Attribute) \
+                and n.func.attr in _BLOCKING_ATTRS:
+            return (f".{n.func.attr}() inside signal handler "
+                    f"'{hname}' — blocking in signal context can "
+                    "deadlock; handlers only set events/flags")
+        return None

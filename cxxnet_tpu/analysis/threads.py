@@ -1,0 +1,147 @@
+"""thread-shutdown: every thread is daemonized or reachably joined.
+
+The ThreadBufferIterator hang class from PR 4: a non-daemon thread
+whose teardown path never joins it keeps the interpreter alive at exit
+(or deadlocks a bounded-queue producer against a consumer that already
+left). The codebase rule: ``threading.Thread(...)`` is created with
+``daemon=True`` (and still joined on orderly teardown where loss of
+buffered work matters), or a ``join()`` must be lexically reachable
+for it.
+
+Heuristic, tuned to this codebase's idioms:
+
+* ``daemon=True`` at construction (or a later ``<target>.daemon =
+  True`` assignment) — OK.
+* thread assigned to a local name — OK when the *enclosing function*
+  contains any ``.join(`` call (covers ``t.join()`` and ``for t in
+  threads: t.join()``).
+* thread assigned to a ``self.<attr>`` — OK when the *enclosing
+  class* joins that attribute anywhere (``self.<attr>.join(...)``),
+  covering the start()/stop() split lifecycle.
+* anonymous ``threading.Thread(...).start()`` — flagged unless
+  daemonized.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from .core import (Finding, LintPass, Project, attr_chain,
+                   build_parents, call_chain, canonical_chain,
+                   import_aliases)
+
+_FN = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _enclosing(node: ast.AST, parents: Dict[int, ast.AST],
+               kinds) -> Optional[ast.AST]:
+    n = parents.get(id(node))
+    while n is not None:
+        if isinstance(n, kinds):
+            return n
+        n = parents.get(id(n))
+    return None
+
+
+class ThreadShutdownPass(LintPass):
+    name = "thread-shutdown"
+    description = ("threading.Thread created without daemon=True or a "
+                   "reachable join() on a teardown path")
+
+    def run(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in project.modules:
+            if mod.tree is None:
+                continue
+            aliases = import_aliases(mod.tree)
+            parents = build_parents(mod.tree)
+            for n in ast.walk(mod.tree):
+                if not isinstance(n, ast.Call):
+                    continue
+                chain = canonical_chain(call_chain(n), aliases)
+                if chain != "threading.Thread":
+                    continue
+                if self._daemonized_at_ctor(n):
+                    continue
+                if self._cleanup_reachable(n, mod.tree, parents):
+                    continue
+                out.append(Finding(
+                    self.name, mod.rel, n.lineno, n.col_offset,
+                    "threading.Thread without daemon=True or a "
+                    "reachable join() — a forgotten non-daemon thread "
+                    "hangs interpreter exit (the PR-4 "
+                    "ThreadBufferIterator class); daemonize it or "
+                    "join it on the teardown path",
+                    mod.line_text(n.lineno)))
+        return out
+
+    def _daemonized_at_ctor(self, call: ast.Call) -> bool:
+        for kw in call.keywords:
+            if kw.arg == "daemon":
+                # daemon=<non-constant> is an explicit choice: trust it
+                return not (isinstance(kw.value, ast.Constant)
+                            and kw.value.value is False)
+        return False
+
+    def _cleanup_reachable(self, call: ast.Call, tree: ast.AST,
+                           parents: Dict[int, ast.AST]) -> bool:
+        # ascend to the statement that consumes the Thread(...) value
+        stmt = call
+        while parents.get(id(stmt)) is not None \
+                and not isinstance(stmt, ast.stmt):
+            stmt = parents[id(stmt)]
+        target = None
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            target = stmt.targets[0]
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            target = stmt.target
+
+        if isinstance(target, ast.Attribute) and isinstance(
+                target.value, ast.Name) and target.value.id == "self":
+            scope = _enclosing(call, parents, (ast.ClassDef,)) or tree
+            return self._attr_cleanup(scope, target.attr)
+        # local-name (or comprehension) target: any join in the
+        # enclosing function counts — covers loop-over-list joins
+        scope = _enclosing(call, parents, _FN)
+        if scope is None:
+            scope = tree           # module-level script code
+        if target is None and scope is tree:
+            return False           # anonymous module-level thread
+        return self._any_join(scope)
+
+    def _any_join(self, scope: ast.AST) -> bool:
+        for n in ast.walk(scope):
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr == "join" \
+                    and not isinstance(n.func.value, ast.Constant) \
+                    and not attr_chain(n.func).endswith("path.join"):
+                return True
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and t.attr == "daemon" \
+                            and isinstance(n.value, ast.Constant) \
+                            and n.value.value is True:
+                        return True
+        return False
+
+    def _attr_cleanup(self, cls: ast.AST, attr: str) -> bool:
+        for n in ast.walk(cls):
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr == "join":
+                v = n.func.value
+                if isinstance(v, ast.Attribute) and v.attr == attr:
+                    return True
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and t.attr == "daemon" \
+                            and isinstance(t.value, ast.Attribute) \
+                            and t.value.attr == attr \
+                            and isinstance(n.value, ast.Constant) \
+                            and n.value.value is True:
+                        return True
+        return False

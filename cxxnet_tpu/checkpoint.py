@@ -335,15 +335,15 @@ def find_latest_valid(model_dir: str, sweep_tmp: bool = True,
     verification pass ALREADY read instead of re-reading the archive
     (halves resume/rollback IO on multi-GB remote checkpoints)."""
     if sweep_tmp and stream.isdir(model_dir):
-        own_suffix = f".tmp.{os.getpid()}"
         for fn in stream.listdir(model_dir):
             # never touch THIS process's tmp files (an async save thread
-            # may be mid-write; the pid suffix only separates processes),
-            # and never touch a FRESH tmp from another process — a serve
-            # or resume job sharing model_dir with a live trainer must
-            # not delete its in-progress write (os.remove succeeds on
-            # open files; only age proves the writer is dead)
-            if ".tmp" in fn and not fn.endswith(own_suffix):
+            # may be mid-write; stream.is_own_tmp owns the pid/seq
+            # naming scheme), and never touch a FRESH tmp from another
+            # process — a serve or resume job sharing model_dir with a
+            # live trainer must not delete its in-progress write
+            # (os.remove succeeds on open files; only age proves the
+            # writer is dead)
+            if ".tmp" in fn and not stream.is_own_tmp(fn):
                 path = os.path.join(model_dir, fn)
                 try:
                     if time.time() - stream.getmtime(path) \

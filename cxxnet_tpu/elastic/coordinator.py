@@ -225,10 +225,11 @@ class ElasticCoordinator:
                   flush=True)
 
     def _write_heartbeat(self) -> None:
-        # locked: the daemon tick and the driver thread's ack()/join()
-        # would otherwise share one pid-named tmp file and could tear
-        # it (write_bytes_atomic's tmp name is pid-unique, not
-        # thread-unique)
+        # locked: write_bytes_atomic's tmp names are now per-call
+        # unique (no tearing), but the daemon tick and the driver
+        # thread's ack()/join() still race the RENAME — without the
+        # lock a stale tick could land after an ack and re-publish the
+        # old acting_gen, stalling the handover barrier
         with self._hb_lock:
             _atomic_write_json(self._member_path(self.worker), {
                 "worker": self.worker, "pid": os.getpid(),

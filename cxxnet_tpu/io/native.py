@@ -42,10 +42,6 @@ def _load():
             lib.cxn_jpeg_decode.argtypes = [
                 ctypes.c_char_p, ctypes.c_long, ctypes.c_int,
                 ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
-            lib.cxn_normalize.restype = None
-            lib.cxn_normalize.argtypes = [
-                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_float,
-                ctypes.c_void_p, ctypes.c_long]
             _lib = lib
         except OSError:
             _lib = None
@@ -75,24 +71,4 @@ def try_decode(data: bytes, want_channels: int = 3) -> Optional[np.ndarray]:
                              h.value, w.value)
     if rc != 0:
         return None
-    return out
-
-
-def normalize(img_u8: np.ndarray, mean: Optional[np.ndarray],
-              scale: float) -> Optional[np.ndarray]:
-    """(img - mean) * scale in native code; None if lib unavailable."""
-    lib = _load()
-    if lib is None:
-        return None
-    img_u8 = np.ascontiguousarray(img_u8, np.uint8)
-    out = np.empty(img_u8.shape, np.float32)
-    mp = None
-    if mean is not None:
-        mean = np.ascontiguousarray(mean, np.float32)
-        if mean.size != img_u8.size:
-            return None
-        mp = mean.ctypes.data_as(ctypes.c_void_p)
-    lib.cxn_normalize(img_u8.ctypes.data_as(ctypes.c_void_p), mp,
-                      ctypes.c_float(scale),
-                      out.ctypes.data_as(ctypes.c_void_p), img_u8.size)
     return out

@@ -22,6 +22,7 @@ object store.
 from __future__ import annotations
 
 import gzip
+import itertools
 import os
 import re
 from typing import Callable, List, Optional
@@ -39,10 +40,6 @@ _RETRY = RetryPolicy()
 def set_retry_policy(policy: RetryPolicy) -> None:
     global _RETRY
     _RETRY = policy
-
-
-def get_retry_policy() -> RetryPolicy:
-    return _RETRY
 
 
 def _with_retry(fn: Callable, what: str, path: str):
@@ -186,6 +183,22 @@ def getmtime(path: str) -> float:
     return os.path.getmtime(path)
 
 
+#: per-process monotonic counter for tmp-file names (see
+#: write_bytes_atomic — pid alone does not separate threads)
+_TMP_SEQ = itertools.count()
+
+def is_own_tmp(filename: str) -> bool:
+    """Whether a directory entry is a tmp file of THIS process —
+    ``<name>.tmp.<pid>`` (legacy, pre-thread-unique) or
+    ``<name>.tmp.<pid>.<seq>``. The orphan sweeps
+    (checkpoint.find_latest_valid) must never delete them — an async
+    save thread may be mid-write; only the protocol owner here knows
+    the naming scheme. Compiled per call so a forked child never
+    reuses its parent's pid."""
+    return re.search(r"\.tmp\.%d(\.\d+)?$" % os.getpid(),
+                     filename) is not None
+
+
 def write_bytes_atomic(path: str, data: bytes) -> None:
     """Atomic-where-possible write: local files go through tmp+fsync+
     rename so a crash never leaves a torn OR silently-unsynced
@@ -198,11 +211,13 @@ def write_bytes_atomic(path: str, data: bytes) -> None:
                 f.write(data)
         _with_retry(_put, f"write {path}", path)
         return
-    # pid-unique tmp name: two writers racing the same target (multi-host
-    # misconfig, or a retried save overlapping a stuck one) must not
-    # clobber each other's tmp mid-write; each renames its own file and
-    # os.replace keeps the LAST completed write
-    tmp = f"{path}.tmp.{os.getpid()}"
+    # pid+sequence-unique tmp name: two writers racing the same target
+    # (multi-host misconfig, a retried save overlapping a stuck one, or
+    # two THREADS of one process — fleet-snapshot pusher vs round-
+    # boundary push, async save vs driver save) must not clobber each
+    # other's tmp mid-write; each renames its own file and os.replace
+    # keeps the LAST completed write
+    tmp = f"{path}.tmp.{os.getpid()}.{next(_TMP_SEQ)}"
     with open(tmp, "wb") as f:
         f.write(data)
         # flush + fsync BEFORE the rename: os.replace orders the name

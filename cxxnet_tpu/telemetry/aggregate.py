@@ -40,7 +40,6 @@ Stdlib-only, like the registry itself.
 
 from __future__ import annotations
 
-import itertools
 import json
 import math
 import os
@@ -312,26 +311,24 @@ def _host_path(fleet_dir: str, host: int) -> str:
     return os.path.join(fleet_dir, "host_%d.json" % host)
 
 
-_TMP_SEQ = itertools.count()
-
-
 def write_snapshot(fleet_dir: str, host: int,
                    registry: Optional[MetricRegistry] = None,
                    run_id: str = "") -> str:
-    """Atomic push: serialize to a per-call-unique tmp name, rename
-    into place. A concurrent reader sees the previous complete snapshot
-    or the new one, never a torn file — and two concurrent pushers in
-    ONE process (the periodic thread racing a round-boundary push)
-    cannot interleave into each other's tmp file either (pid alone
-    would collide; the counter makes the name unique per call, last
-    rename wins)."""
+    """Atomic push through the ONE durable-write protocol
+    (io.stream.write_bytes_atomic: per-call-unique tmp + fsync +
+    rename + dir fsync). A concurrent reader sees the previous
+    complete snapshot or the new one, never a torn file, and two
+    concurrent pushers in ONE process (the periodic thread racing a
+    round-boundary push) cannot interleave into each other's tmp file
+    either — the helper's tmp names are pid+sequence unique, last
+    rename wins. A host's last snapshot before a crash also survives
+    power loss, which is what the aggregator's post-mortem fleet view
+    reads."""
+    from ..io.stream import write_bytes_atomic
     os.makedirs(fleet_dir, exist_ok=True)
     path = _host_path(fleet_dir, host)
-    tmp = "%s.tmp.%d.%d" % (path, os.getpid(), next(_TMP_SEQ))
     snap = export_snapshot(registry, host=host, run_id=run_id)
-    with open(tmp, "w", encoding="utf-8") as f:
-        json.dump(snap, f)
-    os.replace(tmp, path)
+    write_bytes_atomic(path, json.dumps(snap).encode("utf-8"))
     return path
 
 

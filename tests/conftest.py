@@ -88,6 +88,7 @@ QUICK_MODULES = {
     "test_import_cxxnet.py",
     "test_io_pipeline.py",
     "test_layers.py",
+    "test_lint.py",
     "test_matlab_wrapper.py",
     "test_mixed_precision.py",
     "test_optim.py",
