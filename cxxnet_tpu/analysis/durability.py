@@ -30,6 +30,7 @@ from .core import (Finding, LintPass, Project, call_chain,
 #: trailing '/' marks a package prefix
 DURABLE_MODULES = (
     "cxxnet_tpu/checkpoint.py",
+    "cxxnet_tpu/ckpt_sharded/",            # shard-set writer + manifest
     "cxxnet_tpu/telemetry/ledger.py",
     "cxxnet_tpu/telemetry/aggregate.py",   # fleet snapshot transport
     "cxxnet_tpu/elastic/",

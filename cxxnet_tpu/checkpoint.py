@@ -20,6 +20,16 @@ checkpoint truncated by a killed run can never restore silently-wrong
 weights. ``find_latest_valid`` is the resume scan that SKIPS corrupt /
 truncated / ``.tmp``-orphaned files and falls back to the previous
 round — what ``continue=1`` and the sentinel's rollback both use.
+
+Sharded rounds (doc/tasks.md "Sharded checkpointing"): a round may
+instead be a ``r%04d/`` DIRECTORY of per-host shard files plus a
+manifest written last (``ckpt_sharded/``). Every read surface here is
+format-agnostic — ``_load_groups`` routes directory paths through the
+shard reader, the scan sees both layouts (newest valid of either; the
+shard set wins a same-round tie as the fleet-scale format), rotation
+deletes whole round directories, and ``find_latest_valid``
+QUORUM-validates a set (manifest + every shard present, generations
+consistent, digests match) before trusting it.
 """
 
 from __future__ import annotations
@@ -180,6 +190,13 @@ def _load_groups(path: str, include_opt: bool, verify: bool = True):
 
 
 def _load_groups_inner(path: str, include_opt: bool, verify: bool = True):
+    if _is_shard_path(path):
+        # shard-set round directory: quorum-validated read + chunk
+        # merge, returning the exact (meta, groups) layout the blob
+        # reader produces — load_blob and blob_digest never know
+        from .ckpt_sharded import load_shard_set
+        return load_shard_set(path, include_opt=include_opt,
+                              verify=verify)
     import zipfile
     try:
         if stream.is_remote(path) or failpoints.armed_prefix("io."):
@@ -293,22 +310,62 @@ def model_path(model_dir: str, round_counter: int) -> str:
     return os.path.join(model_dir, "%04d.model" % round_counter)
 
 
+def checkpoint_path(model_dir: str, round_counter: int,
+                    sharded: bool = False) -> str:
+    """Where round ``round_counter`` lives in ``model_dir``: the
+    ``%04d.model`` blob, or (``sharded=True``) the ``r%04d`` shard-set
+    directory — what the trainer's ``shard_ckpt`` knob selects."""
+    if sharded:
+        from .ckpt_sharded import round_dir_path
+        return round_dir_path(model_dir, round_counter)
+    return model_path(model_dir, round_counter)
+
+
+def checkpoint_exists(path: str) -> bool:
+    """Whether a checkpoint round is PUBLISHED at ``path``: for a blob
+    that is file existence; for a shard set only the manifest counts —
+    an unpublished pile of shard files is not a checkpoint."""
+    if _is_shard_path(path):
+        from .ckpt_sharded import manifest_path
+        return stream.exists(manifest_path(path))
+    return stream.exists(path)
+
+
+def _is_shard_path(path: str) -> bool:
+    from .ckpt_sharded import is_shard_round_path
+    return is_shard_round_path(path)
+
+
 # %04d zero-pads but does NOT truncate: round 10000 writes "10000.model",
 # so the scan must accept 4+ digits or long runs silently resume from 9999
 _MODEL_RE = re.compile(r"^(\d{4,})\.model$")
 
 
-def _scan_rounds(model_dir: str) -> List[Tuple[int, str]]:
-    """All (round, path) checkpoints in model_dir, newest first."""
+def _scan_rounds(model_dir: str,
+                 include_torn: bool = False) -> List[Tuple[int, str]]:
+    """All (round, path) checkpoints in model_dir, newest first —
+    ``%04d.model`` blobs and ``r%04d`` shard-set directories alike.
+    A same-round tie lists the shard set first (the fleet-scale format
+    wins when both verify). Manifest-less shard directories (an
+    in-progress or torn write) are excluded from the cheap scan unless
+    ``include_torn`` — the validating scan wants to SEE them so the
+    skip is counted and the fallback is visible."""
+    from .ckpt_sharded import ROUND_DIR_RE, manifest_path
     if not stream.isdir(model_dir):
         return []
     out = []
     for fn in stream.listdir(model_dir):
         m = _MODEL_RE.match(fn)
         if m:
-            out.append((int(m.group(1)), os.path.join(model_dir, fn)))
+            out.append((int(m.group(1)), 0, os.path.join(model_dir, fn)))
+            continue
+        m = ROUND_DIR_RE.match(fn)
+        if m:
+            path = os.path.join(model_dir, fn)
+            if include_torn or stream.exists(manifest_path(path)):
+                out.append((int(m.group(1)), 1, path))
     out.sort(reverse=True)
-    return out
+    return [(r, path) for r, _kind, path in out]
 
 
 def find_latest(model_dir: str) -> Optional[Tuple[int, str]]:
@@ -324,38 +381,24 @@ def find_latest_valid(model_dir: str, sweep_tmp: bool = True,
     """The resume scan ``continue=1`` and sentinel rollback rely on:
     newest checkpoint that PASSES verification, skipping corrupt or
     truncated files (each skip counted under ``ckpt.skipped_invalid``)
-    and falling back round by round. ``sweep_tmp`` also deletes stale
-    ``*.tmp*`` orphans left by writers killed between tmp-write and
-    rename (this process's own tmp files excluded — a live async save
-    thread may own one) — they are never valid checkpoints and a pile
-    of them is how crash loops fill disks.
+    and falling back round by round. Shard-set rounds are
+    QUORUM-validated (manifest + every shard present, per-shard
+    generations matching the manifest, every digest verifying) — a torn
+    set degrades to the newest older valid round of EITHER format.
+    ``sweep_tmp`` also deletes stale ``*.tmp*`` orphans left by writers
+    killed between tmp-write and rename (this process's own tmp files
+    excluded — a live async save thread may own one) and stale
+    manifest-less shard directories (see :func:`_sweep_orphans`) — they
+    are never valid checkpoints and a pile of them is how crash loops
+    fill disks.
 
     Returns ``(round, path)`` — or ``(round, path, blob)`` with
     ``want_blob=True`` so the caller restores from the bytes the
     verification pass ALREADY read instead of re-reading the archive
     (halves resume/rollback IO on multi-GB remote checkpoints)."""
     if sweep_tmp and stream.isdir(model_dir):
-        for fn in stream.listdir(model_dir):
-            # never touch THIS process's tmp files (an async save thread
-            # may be mid-write; stream.is_own_tmp owns the pid/seq
-            # naming scheme), and never touch a FRESH tmp from another
-            # process — a serve or resume job sharing model_dir with a
-            # live trainer must not delete its in-progress write
-            # (os.remove succeeds on open files; only age proves the
-            # writer is dead)
-            if ".tmp" in fn and not stream.is_own_tmp(fn):
-                path = os.path.join(model_dir, fn)
-                try:
-                    if time.time() - stream.getmtime(path) \
-                            < TMP_SWEEP_MIN_AGE_S:
-                        continue
-                    stream.remove(path)
-                    counters.inc("ckpt.tmp_swept")
-                    if verbose:
-                        print(f"checkpoint scan: swept orphan {fn}")
-                except OSError:
-                    pass             # racing writer owns it; leave it be
-    for r, path in _scan_rounds(model_dir):
+        _sweep_orphans(model_dir, verbose)
+    for r, path in _scan_rounds(model_dir, include_torn=True):
         try:
             meta, groups = _load_groups(path, include_opt=True,
                                         verify=True)
@@ -369,16 +412,117 @@ def find_latest_valid(model_dir: str, sweep_tmp: bool = True,
     return None
 
 
+def _sweep_orphans(model_dir: str, verbose: bool) -> None:
+    """The resume scan's tmp-orphan sweep, shard-set aware: stale
+    ``*.tmp*`` files in model_dir AND inside shard round directories
+    are reaped; a manifest-less shard directory whose every file went
+    stale is a crash orphan and is reaped whole. The sweep NEVER
+    touches this process's own tmp files (stream.is_own_tmp — a live
+    async save thread may own one) nor anything fresh (another live
+    writer's in-progress shards; only age proves a writer dead)."""
+    from .ckpt_sharded import MANIFEST, ROUND_DIR_RE
+
+    def _sweep_tmp_in(dir_path: str, names: List[str]) -> None:
+        for fn in names:
+            # never touch THIS process's tmp files (an async save
+            # thread may be mid-write; stream.is_own_tmp owns the
+            # pid/seq naming scheme), and never touch a FRESH tmp from
+            # another process — a serve or resume job sharing model_dir
+            # with a live trainer must not delete its in-progress write
+            # (os.remove succeeds on open files; only age proves the
+            # writer is dead)
+            if ".tmp" in fn and not stream.is_own_tmp(fn):
+                path = os.path.join(dir_path, fn)
+                try:
+                    if time.time() - stream.getmtime(path) \
+                            < TMP_SWEEP_MIN_AGE_S:
+                        continue
+                    stream.remove(path)
+                    counters.inc("ckpt.tmp_swept")
+                    if verbose:
+                        print(f"checkpoint scan: swept orphan {fn}")
+                except OSError:
+                    pass         # racing writer owns it; leave it be
+
+    entries = stream.listdir(model_dir)
+    _sweep_tmp_in(model_dir, entries)
+    for fn in entries:
+        if ROUND_DIR_RE.match(fn) is None:
+            continue
+        rdir = os.path.join(model_dir, fn)
+        if not stream.isdir(rdir):
+            continue
+        try:
+            inner = stream.listdir(rdir)
+        except OSError:
+            continue
+        _sweep_tmp_in(rdir, inner)
+        if MANIFEST in inner:
+            continue             # published: validation's problem, not ours
+        try:
+            inner = stream.listdir(rdir)   # post tmp sweep
+            if any(stream.is_own_tmp(f) for f in inner):
+                # OUR async save thread owns a file in here (however
+                # old — a stalled remote write is still a live write):
+                # the whole dir is off limits, same own-tmp contract
+                # as the per-file sweep
+                continue
+            # age every file — and for an EMPTY dir (a live writer
+            # between makedirs and its first shard write) the
+            # directory's own mtime, so all([]) can never read a
+            # just-created dir as stale
+            ages = [time.time() - stream.getmtime(os.path.join(rdir, f))
+                    for f in inner] \
+                or [time.time() - stream.getmtime(rdir)]
+            if not all(a >= TMP_SWEEP_MIN_AGE_S for a in ages):
+                continue         # a live writer's in-progress shards
+            for f in inner:
+                stream.remove(os.path.join(rdir, f))
+            if not stream.is_remote(rdir):
+                os.rmdir(rdir)
+            counters.inc("ckpt.shard_dir_swept")
+            if verbose:
+                print(f"checkpoint scan: swept torn shard set {fn}")
+        except OSError:
+            pass                 # racing writer/reader; leave it be
+
+
 def rotate_checkpoints(model_dir: str, keep_last_n: int) -> List[str]:
     """Delete all but the newest ``keep_last_n`` checkpoints (0 = keep
     everything). Returns the deleted paths. Deletion failures are
-    non-fatal — rotation is hygiene, not correctness."""
+    non-fatal — rotation is hygiene, not correctness. A shard-set round
+    deletes as a whole directory, atomically-enough: the manifest goes
+    FIRST — its removal atomically UN-publishes the set (the exact
+    inverse of the writer's manifest-last publish), so a reader racing
+    the deletion sees a quorum-invalid set and falls back, and a crash
+    mid-rotation leaves a manifest-less stale pile the orphan sweep
+    reclaims (a manifest-ful half-deleted dir would be re-scanned and
+    re-rejected forever) — then the shard files, then the empty
+    directory."""
     if keep_last_n <= 0:
         return []
     deleted = []
-    for _r, path in _scan_rounds(model_dir)[keep_last_n:]:
+    # retention is promised in ROUNDS, not directory entries: a round
+    # present in BOTH formats (a run that flipped shard_ckpt) counts
+    # once, and both its representations are kept or dropped together
+    kept_rounds: set = set()
+    victims = []
+    for r, path in _scan_rounds(model_dir):
+        if r in kept_rounds or len(kept_rounds) < keep_last_n:
+            kept_rounds.add(r)
+            continue
+        victims.append(path)
+    for path in victims:
         try:
-            stream.remove(path)
+            if _is_shard_path(path) and stream.isdir(path):
+                from .ckpt_sharded import MANIFEST
+                names = stream.listdir(path)
+                for fn in sorted(names, key=lambda f: f != MANIFEST):
+                    stream.remove(os.path.join(path, fn))
+                if not stream.is_remote(path):
+                    os.rmdir(path)
+            else:
+                stream.remove(path)
             deleted.append(path)
         except OSError:
             pass

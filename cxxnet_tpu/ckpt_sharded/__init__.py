@@ -1,0 +1,17 @@
+"""Sharded, quorum-validated checkpointing (doc/tasks.md "Sharded
+checkpointing"): per-host shard files + a manifest written last, layout
+derived from the ``parallel/rules.py`` partition specs, per-array
+sha256 carried forward from the blob format so digests compare across
+formats. ``checkpoint.find_latest_valid`` quorum-validates whole sets
+and falls back a round on any violation, exactly like the blob path."""
+
+from .format import (MANIFEST, ROUND_DIR_RE, is_shard_round_path,
+                     load_shard_set, manifest_path, round_dir_path,
+                     round_dirname)
+from .writer import STALL_ENV, save_shard_set
+
+__all__ = [
+    "MANIFEST", "ROUND_DIR_RE", "STALL_ENV", "is_shard_round_path",
+    "load_shard_set", "manifest_path", "round_dir_path",
+    "round_dirname", "save_shard_set",
+]

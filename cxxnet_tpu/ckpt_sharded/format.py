@@ -1,0 +1,388 @@
+"""Shard-set checkpoint format: layout, manifest, quorum validation.
+
+A *shard set* is one checkpoint round written as a DIRECTORY instead of
+one monolithic ``%04d.model`` blob::
+
+    model_dir/
+      r0012/
+        shard_00of02.bin      # npz of this shard's entries + __shard_meta__
+        shard_01of02.bin
+        MANIFEST.json         # written LAST — its presence publishes the set
+
+The layout derives from the same ``parallel/rules.py`` partition specs
+that drive device placement: a leaf whose spec shards dim ``d`` is split
+into chunk entries along ``d`` (the file-level analog of its device
+sharding — at fleet scale each host writes/reads the chunk files of the
+leaves it owns), replicated leaves stay whole, and entries are packed
+into ``n_shards`` files by deterministic greedy size balancing.
+
+Integrity is two-level, both carried by the manifest:
+
+* **full-array digests** — the PR-3 per-array sha256 scheme over the
+  UN-chunked arrays. Identical to what the blob format stores, so
+  :func:`checkpoint.blob_digest` of a shard-set meta equals the blob
+  digest of the same state saved as a ``.model`` file — digests compare
+  across formats (the chaos smoke's bit-exactness oracle).
+* **per-entry digests + a write generation** — each chunk entry's
+  sha256 plus a content-derived generation id stamped into every shard
+  file's ``__shard_meta__``. Quorum validation
+  (:func:`load_shard_set`) requires: manifest present and parseable,
+  every listed shard file present, every shard's embedded generation ==
+  the manifest generation (a stale shard from an older torn write can
+  never be mixed into a newer set), every listed entry present with a
+  matching digest, and the merged full arrays matching the full-array
+  digests. Anything less raises :class:`checkpoint.CheckpointCorrupt`
+  and the resume scan falls back a round, exactly like the blob path.
+
+The generation id is derived from the content digests (not a random
+nonce), so every rank of a multi-host writer stamps the same id without
+communicating — and a bit-identical re-write of a previously torn round
+composes harmlessly with its leftovers.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io as _io
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import checkpoint as ckpt
+from ..io import stream
+
+#: manifest filename inside a round directory; its atomic write is the
+#: publish point of the whole set (shards first, manifest last)
+MANIFEST = "MANIFEST.json"
+
+FORMAT_NAME = "cxxnet-shard-set"
+FORMAT_VERSION = 1
+
+#: round directories are ``r%04d`` (zero-padded, not truncated — same
+#: 4+ digit contract as the blob scan)
+ROUND_DIR_RE = re.compile(r"^r(\d{4,})$")
+
+_SHARD_RE = re.compile(r"^shard_(\d+)of(\d+)\.bin$")
+
+#: separator between a flat array path and its chunk tag; '/' never
+#: appears in it so chunk entries cannot collide with array paths
+_CHUNK_SEP = "::"
+
+
+def round_dirname(round_counter: int) -> str:
+    return "r%04d" % round_counter
+
+
+def round_dir_path(model_dir: str, round_counter: int) -> str:
+    return os.path.join(model_dir, round_dirname(round_counter))
+
+
+def is_shard_round_path(path: str) -> bool:
+    """Whether a checkpoint path names a shard-set round directory
+    (by NAME — the torn/absent cases matter exactly when the directory
+    contents cannot be trusted)."""
+    return ROUND_DIR_RE.match(os.path.basename(path.rstrip("/"))) is not None
+
+
+def shard_filename(idx: int, n_shards: int) -> str:
+    return "shard_%02dof%02d.bin" % (idx, n_shards)
+
+
+def manifest_path(dir_path: str) -> str:
+    return os.path.join(dir_path, MANIFEST)
+
+
+# -- chunk planning -----------------------------------------------------------
+
+def chunk_plan_from_specs(spec_map: Optional[Dict[str, Any]],
+                          arrays: Dict[str, np.ndarray],
+                          n_shards: int) -> Dict[str, Tuple[int, int]]:
+    """{flat_path: (dim, chunks)} for every array worth splitting: the
+    first dim its PartitionSpec shards (the device-sharding dim — FSDP/
+    TP masters and optimizer state), split ``n_shards`` ways when that
+    divides evenly. Replicated leaves, scalar leaves, and non-dividing
+    shapes stay whole — same at-rest minority policy as the placement
+    rules themselves."""
+    plan: Dict[str, Tuple[int, int]] = {}
+    if not spec_map or n_shards <= 1:
+        return plan
+    for path, arr in arrays.items():
+        spec = spec_map.get(path)
+        if not spec:
+            continue
+        shape = np.shape(arr)
+        for d, ax in enumerate(spec):
+            if ax is None or d >= len(shape):
+                continue
+            if shape[d] >= n_shards and shape[d] % n_shards == 0:
+                plan[path] = (d, n_shards)
+            break               # first sharded dim decides, like FSDP
+    return plan
+
+
+def chunk_entries(arrays: Dict[str, np.ndarray],
+                  plan: Dict[str, Tuple[int, int]]
+                  ) -> Dict[str, np.ndarray]:
+    """Explode planned arrays into chunk entries
+    (``path::c<j>of<k>d<dim>``); unplanned arrays pass through whole."""
+    out: Dict[str, np.ndarray] = {}
+    for path, arr in arrays.items():
+        if path not in plan:
+            out[path] = arr
+            continue
+        dim, k = plan[path]
+        for j, piece in enumerate(np.split(arr, k, axis=dim)):
+            out[f"{path}{_CHUNK_SEP}c{j}of{k}d{dim}"] = \
+                np.ascontiguousarray(piece)
+    return out
+
+
+_CHUNK_TAG_RE = re.compile(r"^c(\d+)of(\d+)d(\d+)$")
+
+
+def entry_base(entry: str) -> str:
+    """Full-array path of an entry (chunked or whole)."""
+    return entry.split(_CHUNK_SEP, 1)[0]
+
+
+def merge_entries(entries: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Reassemble full arrays from chunk entries (np.concatenate along
+    the recorded dim, chunk order) — the gather half of the
+    gather-and-merge restore. Raises CheckpointCorrupt on an
+    inconsistent chunk family (missing piece, disagreeing k/dim)."""
+    whole: Dict[str, np.ndarray] = {}
+    families: Dict[str, Dict[int, np.ndarray]] = {}
+    fam_info: Dict[str, Tuple[int, int]] = {}
+    for name, arr in entries.items():
+        if _CHUNK_SEP not in name:
+            whole[name] = arr
+            continue
+        base, tag = name.split(_CHUNK_SEP, 1)
+        m = _CHUNK_TAG_RE.match(tag)
+        if m is None:
+            raise ckpt.CheckpointCorrupt(
+                f"unparseable chunk entry name {name!r}")
+        j, k, d = (int(m.group(i)) for i in (1, 2, 3))
+        info = fam_info.setdefault(base, (k, d))
+        if info != (k, d):
+            raise ckpt.CheckpointCorrupt(
+                f"chunk family {base!r} disagrees on layout "
+                f"({info} vs {(k, d)})")
+        families.setdefault(base, {})[j] = arr
+    for base, pieces in families.items():
+        k, d = fam_info[base]
+        if sorted(pieces) != list(range(k)):
+            raise ckpt.CheckpointCorrupt(
+                f"chunk family {base!r} incomplete: have "
+                f"{sorted(pieces)} of {k}")
+        whole[base] = np.concatenate([pieces[j] for j in range(k)], axis=d)
+    return whole
+
+
+def assign_shards(entries: Dict[str, np.ndarray], n_shards: int
+                  ) -> List[List[str]]:
+    """Deterministic greedy size balancing of entries over shard files:
+    biggest first (name-tiebroken) onto the least-loaded shard
+    (index-tiebroken). Every writer computes the identical assignment
+    from the identical tree — no coordination needed."""
+    n_shards = max(1, int(n_shards))
+    order = sorted(entries,
+                   key=lambda e: (-entries[e].nbytes, e))
+    loads = [0] * n_shards
+    out: List[List[str]] = [[] for _ in range(n_shards)]
+    for name in order:
+        i = min(range(n_shards), key=lambda s: (loads[s], s))
+        out[i].append(name)
+        loads[i] += entries[name].nbytes
+    for bucket in out:
+        bucket.sort()
+    return out
+
+
+# -- generation + manifest ----------------------------------------------------
+
+def generation_id(digests: Dict[str, str], round_counter: int,
+                  step_count: int) -> str:
+    """Content-derived write-generation id (16 hex): every rank of one
+    save derives the same id from the gathered tree; two writes of
+    different content never share one."""
+    h = hashlib.sha256()
+    h.update(f"{round_counter}:{step_count}:".encode("ascii"))
+    for k in sorted(digests):
+        h.update(f"{k}={digests[k]};".encode("ascii"))
+    return h.hexdigest()[:16]
+
+
+def build_manifest(*, structure_sig_json: str, round_counter: int,
+                   epoch_counter: int, step_count: int, lr_scale: float,
+                   has_opt: bool, digests: Dict[str, str],
+                   generation: str, n_shards: int,
+                   shard_entries: List[List[str]],
+                   entry_digests: Dict[str, str],
+                   entry_bytes: Dict[str, int]) -> Dict[str, Any]:
+    shards = []
+    for i, names in enumerate(shard_entries):
+        shards.append({
+            "file": shard_filename(i, n_shards),
+            "entries": {e: entry_digests[e] for e in names},
+            "bytes": int(sum(entry_bytes[e] for e in names)),
+        })
+    return {
+        "format": FORMAT_NAME,
+        "format_version": FORMAT_VERSION,
+        # the blob meta's restore fields, same names, so the one blob
+        # dict Trainer.load_blob consumes works for both formats
+        "structure_sig": structure_sig_json,
+        "round": int(round_counter),
+        "epoch": int(epoch_counter),
+        "step_count": int(step_count),
+        "lr_scale": float(lr_scale),
+        "has_opt": bool(has_opt),
+        "digests": digests,          # FULL-array digests (blob-compatible)
+        "generation": generation,
+        "n_shards": int(n_shards),
+        "shards": shards,
+    }
+
+
+def shard_blob(entries: Dict[str, np.ndarray], *, generation: str,
+               shard_idx: int, n_shards: int, round_counter: int) -> bytes:
+    """Serialize one shard file: npz of its entries plus an embedded
+    ``__shard_meta__`` carrying the write generation — the field quorum
+    validation compares against the manifest so a stale shard from an
+    older torn write can never satisfy a newer manifest."""
+    meta = {"generation": generation, "shard": int(shard_idx),
+            "n_shards": int(n_shards), "round": int(round_counter)}
+    arrays = dict(entries)
+    arrays["__shard_meta__"] = np.frombuffer(
+        json.dumps(meta).encode("utf-8"), dtype=np.uint8)
+    buf = _io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+# -- quorum validation + load -------------------------------------------------
+
+def _read_manifest(dir_path: str) -> Dict[str, Any]:
+    path = manifest_path(dir_path)
+    try:
+        raw = stream.read_bytes(path)
+        man = json.loads(raw.decode("utf-8"))
+    except (OSError, ValueError) as e:
+        raise ckpt.CheckpointCorrupt(
+            f"{dir_path}: shard set has no readable manifest "
+            f"({type(e).__name__}: {e})") from e
+    if not isinstance(man, dict) or man.get("format") != FORMAT_NAME:
+        raise ckpt.CheckpointCorrupt(
+            f"{path}: not a {FORMAT_NAME} manifest")
+    if int(man.get("format_version", 0)) > FORMAT_VERSION:
+        raise ckpt.CheckpointCorrupt(
+            f"{path}: manifest format_version "
+            f"{man.get('format_version')} is newer than this reader "
+            f"({FORMAT_VERSION})")
+    return man
+
+
+def _read_shard(dir_path: str, rec: Dict[str, Any], generation: str,
+                want: Optional[set]) -> Dict[str, np.ndarray]:
+    """Read + structurally validate one shard file against its manifest
+    record. ``want`` filters entries (None = all) — an inference load
+    skips whole shards whose entries are all optimizer state."""
+    import zipfile
+    fname = str(rec.get("file", ""))
+    listed = rec.get("entries", {})
+    path = os.path.join(dir_path, fname)
+    try:
+        src = _io.BytesIO(stream.read_bytes(path))
+        with np.load(src, allow_pickle=False) as z:
+            names = set(z.files)
+            if "__shard_meta__" not in names:
+                raise ckpt.CheckpointCorrupt(
+                    f"{path}: shard has no embedded meta")
+            smeta = json.loads(
+                bytes(z["__shard_meta__"]).decode("utf-8"))
+            out = {k: z[k] for k in names - {"__shard_meta__"}
+                   if want is None or k in want}
+    except (OSError, zipfile.BadZipFile, ValueError, KeyError, EOFError,
+            json.JSONDecodeError) as e:
+        if isinstance(e, ckpt.CheckpointCorrupt):
+            raise
+        raise ckpt.CheckpointCorrupt(
+            f"{path}: torn/missing shard ({type(e).__name__}: {e})") from e
+    if str(smeta.get("generation")) != generation:
+        raise ckpt.CheckpointCorrupt(
+            f"{path}: shard generation {smeta.get('generation')!r} does "
+            f"not match manifest generation {generation!r} — stale "
+            "shard from an older (torn) write")
+    have = set(out)
+    need = {e for e in listed if want is None or e in want}
+    if have != need:
+        raise ckpt.CheckpointCorrupt(
+            f"{path}: shard entries do not match manifest "
+            f"(missing {sorted(need - have)[:3]}, "
+            f"extra {sorted(have - need)[:3]})")
+    return out
+
+
+def load_shard_set(dir_path: str, include_opt: bool = True,
+                   verify: bool = True):
+    """Quorum-validate a shard-set round and return ``(meta, groups)``
+    in exactly the layout :func:`checkpoint._load_groups_inner` produces
+    for a blob — the one restore surface upstream of ``load_blob``.
+
+    The quorum rule: manifest readable, every listed shard present with
+    the manifest's generation, every listed entry present with its
+    digest, merged full arrays matching the full-array digest map.
+    Any violation raises :class:`checkpoint.CheckpointCorrupt`; the
+    resume scan then falls back a round, exactly like a torn blob."""
+    man = _read_manifest(dir_path)
+    generation = str(man.get("generation", ""))
+    digests = man.get("digests", {})
+    shards = man.get("shards", [])
+    if len(shards) != int(man.get("n_shards", -1)):
+        raise ckpt.CheckpointCorrupt(
+            f"{dir_path}: manifest lists {len(shards)} shard(s) but "
+            f"declares n_shards={man.get('n_shards')}")
+    want: Optional[set] = None
+    if not include_opt:
+        want = {e for rec in shards for e in rec.get("entries", {})
+                if not entry_base(e).startswith("opt/")}
+    entries: Dict[str, np.ndarray] = {}
+    for rec in shards:
+        if want is not None and not any(
+                e in want for e in rec.get("entries", {})):
+            continue            # all-optimizer shard: never read for serving
+        part = _read_shard(dir_path, rec, generation, want)
+        if verify:
+            listed = rec.get("entries", {})
+            for e, arr in part.items():
+                got = ckpt._digest(arr)
+                if got != listed.get(e):
+                    raise ckpt.CheckpointCorrupt(
+                        f"{dir_path}/{rec.get('file')}: digest mismatch "
+                        f"for entry {e!r} (want "
+                        f"{str(listed.get(e))[:12]}.., got {got[:12]}..)")
+        entries.update(part)
+    arrays = merge_entries(entries)
+    if verify:
+        for k, v in arrays.items():
+            wantd = digests.get(k)
+            if wantd is None:
+                raise ckpt.CheckpointCorrupt(
+                    f"{dir_path}: array {k!r} missing from digest map")
+            got = ckpt._digest(v)
+            if got != wantd:
+                raise ckpt.CheckpointCorrupt(
+                    f"{dir_path}: merged digest mismatch for {k!r} "
+                    f"(want {wantd[:12]}.., got {got[:12]}..)")
+    meta = {k: v for k, v in man.items() if k != "shards"}
+    groups: Dict[str, Dict[str, np.ndarray]] = {"params": {}, "state": {}}
+    if include_opt:
+        groups["opt"] = {}
+    for k, v in arrays.items():
+        head, _, rest = k.partition("/")
+        groups.setdefault(head, {})[rest] = v
+    return meta, groups

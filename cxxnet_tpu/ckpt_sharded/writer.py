@@ -1,0 +1,186 @@
+"""Shard-set checkpoint writer: per-shard atomic files, manifest last.
+
+Write protocol (what makes a torn writer safe for every reader):
+
+1. each shard file lands via ``io.stream.write_bytes_atomic``
+   (tmp + fsync + rename + dir fsync — the atomic-io invariant);
+2. the manifest is written LAST, also atomically. A writer killed at
+   ANY point before the manifest leaves either no round directory, or
+   a manifest-less pile of shard files — both quorum-rejected by the
+   resume scan, which falls back a round (tools/smoke_shardckpt.py is
+   the SIGKILL proof);
+3. a shard write that fails (IO error, the ``ckpt.shard_write``
+   failpoint) aborts the set BEFORE the manifest: the failure degrades
+   at the call site (warn + the ``ckpt.write_failures`` counter, via
+   the same periodic-save path the blob format uses) instead of
+   killing training, and the partial set is invisible to readers.
+
+Multi-host fleets: every rank calls :func:`save_shard_set` with its
+``rank``/``world`` and writes only the shard files assigned to it
+(``idx % world == rank``); rank 0 writes the manifest LAST. The entry
+assignment and the content-derived generation id are deterministic
+functions of the gathered tree, so ranks agree without communicating —
+but manifest-last publication needs "last" to hold ACROSS ranks, so
+the caller passes ``barrier`` (Trainer wires the jax coordination-
+service barrier — a TCP wait, safe on the async writer thread, no
+device collective): every rank joins it after its shards are durable
+and rank 0 publishes only once it returns. A barrier that fails or
+times out (a peer died mid-save) degrades to publishing anyway with a
+warning — the incomplete set is quorum-rejected by every reader, which
+is the torn-writer story readers already handle, and a wedged save
+must not wedge training.
+
+Observability: each shard write lands a ``ckpt_shard_write`` ledger
+event (round, shard, bytes, seconds) plus the ``shard_write`` op in the
+``cxxnet_ckpt_io_seconds`` histogram; the set-level ``ckpt_save`` event
+gains ``format="shard"``, ``shards``, ``manifest`` and ``set_digest``
+fields (tools/report.py renders per-shard bytes/latency from these).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+from .. import checkpoint as ckpt
+from ..io import stream
+from ..resilience import failpoints
+from ..telemetry.ledger import LEDGER
+from ..telemetry.trace import TRACER
+from . import format as fmt
+
+#: chaos-test hook: stall this many seconds before EACH shard-file
+#: write (env, read per save). Exists so the SIGKILL chaos smoke can
+#: reliably land a kill between a shard write and the manifest without
+#: guessing at filesystem timing; never set in production.
+STALL_ENV = "CXXNET_SHARD_WRITE_STALL_S"
+
+
+def _stall_s() -> float:
+    try:
+        return float(os.environ.get(STALL_ENV, "") or 0.0)
+    except ValueError:
+        return 0.0
+
+
+def save_shard_set(dir_path: str, *, structure_sig: tuple,
+                   round_counter: int, epoch_counter: int,
+                   params: Any, net_state: Any,
+                   opt_state: Optional[Any] = None,
+                   step_count: int = 0, lr_scale: float = 1.0,
+                   n_shards: int = 1,
+                   spec_map: Optional[Dict[str, Any]] = None,
+                   rank: int = 0, world: int = 1,
+                   barrier=None) -> None:
+    """Write one checkpoint round as a shard set under ``dir_path``
+    (``model_dir/r%04d``). Mirrors ``checkpoint.save_model``'s
+    timing/ledger envelope; raises on failure (callers own the
+    degrade-don't-die policy, same as the blob path). ``barrier``:
+    optional zero-arg callable every rank runs between its shard
+    writes and the manifest publish (see module docstring)."""
+    t0 = time.perf_counter()
+    ok = False
+    n_written = 0
+    set_digest = ""
+    try:
+        n_written, set_digest = _save_shard_set(
+            dir_path, structure_sig=structure_sig,
+            round_counter=round_counter, epoch_counter=epoch_counter,
+            params=params, net_state=net_state, opt_state=opt_state,
+            step_count=step_count, lr_scale=lr_scale,
+            n_shards=n_shards, spec_map=spec_map, rank=rank,
+            world=world, barrier=barrier)
+        ok = True
+    finally:
+        t1 = time.perf_counter()
+        ckpt._H_CKPT.labels("save").observe(t1 - t0)
+        TRACER.add_complete("ckpt.save", t0, t1, cat="ckpt",
+                            args={"round": round_counter,
+                                  "format": "shard"})
+        LEDGER.event("ckpt_save", round=round_counter, path=dir_path,
+                     seconds=round(t1 - t0, 4), ok=ok, format="shard",
+                     shards=n_written,
+                     manifest=fmt.manifest_path(dir_path),
+                     set_digest=set_digest)
+
+
+def _save_shard_set(dir_path: str, *, structure_sig, round_counter,
+                    epoch_counter, params, net_state, opt_state,
+                    step_count, lr_scale, n_shards, spec_map,
+                    rank, world, barrier=None):
+    failpoints.check("ckpt.write", IOError)
+    arrays: Dict[str, Any] = {}
+    ckpt._flatten("params", ckpt.jax_to_numpy(params), arrays)
+    ckpt._flatten("state", ckpt.jax_to_numpy(net_state), arrays)
+    if opt_state is not None:
+        ckpt._flatten("opt", ckpt.jax_to_numpy(opt_state), arrays)
+    n_shards = max(1, int(n_shards))
+    world = max(1, int(world))
+    # FULL-array digests first: blob-compatible content identity, and
+    # the seed of the content-derived generation every rank agrees on
+    digests = {k: ckpt._digest(v) for k, v in arrays.items()}
+    generation = fmt.generation_id(digests, round_counter, step_count)
+    plan = fmt.chunk_plan_from_specs(spec_map, arrays, n_shards)
+    entries = fmt.chunk_entries(arrays, plan)
+    assignment = fmt.assign_shards(entries, n_shards)
+    stream.makedirs(dir_path)
+    stall = _stall_s()
+    mine = 0
+    for idx, names in enumerate(assignment):
+        if idx % world != rank:
+            continue            # another host owns this shard file
+        if stall > 0:
+            time.sleep(stall)   # chaos-test hook (STALL_ENV)
+        failpoints.check("ckpt.shard_write", IOError)
+        blob = fmt.shard_blob(
+            {e: entries[e] for e in names}, generation=generation,
+            shard_idx=idx, n_shards=n_shards,
+            round_counter=round_counter)
+        ts0 = time.perf_counter()
+        stream.write_bytes_atomic(
+            os.path.join(dir_path, fmt.shard_filename(idx, n_shards)),
+            blob)
+        ts1 = time.perf_counter()
+        ckpt._H_CKPT.labels("shard_write").observe(ts1 - ts0)
+        LEDGER.event("ckpt_shard_write", round=round_counter,
+                     shard=idx, shards=n_shards, bytes=len(blob),
+                     seconds=round(ts1 - ts0, 4))
+        mine += 1
+    set_digest = ckpt.blob_digest({"digests": digests})
+    if barrier is not None:
+        # cross-rank "all shards durable" point: every rank joins so
+        # rank 0's manifest-last publish stays LAST across the fleet,
+        # not just locally. A failed/timed-out barrier (a peer died
+        # mid-save) publishes anyway with a warning — readers quorum-
+        # reject the incomplete set, the same torn-writer story they
+        # already handle, and a wedged peer must not wedge training.
+        try:
+            barrier()
+        except Exception as e:       # noqa: BLE001 — degrade, don't die
+            print(f"WARNING: checkpoint shard barrier failed "
+                  f"({type(e).__name__}: {e}); publishing round "
+                  f"{round_counter}'s manifest without it", flush=True)
+    if rank == 0:
+        # manifest LAST: its atomic write is what publishes the set —
+        # every earlier crash leaves only a quorum-rejected pile.
+        # Entry digests are built here, on the publishing rank only
+        # (peers would hash the whole tree for a manifest they never
+        # write); unchunked entries reuse the full-array digest.
+        entry_digests = {
+            e: (digests[e] if fmt.entry_base(e) == e
+                else ckpt._digest(entries[e])) for e in entries}
+        entry_bytes = {e: int(entries[e].nbytes) for e in entries}
+        man = fmt.build_manifest(
+            structure_sig_json=ckpt._sig_to_json(structure_sig),
+            round_counter=round_counter, epoch_counter=epoch_counter,
+            step_count=step_count, lr_scale=lr_scale,
+            has_opt=opt_state is not None, digests=digests,
+            generation=generation, n_shards=n_shards,
+            shard_entries=assignment, entry_digests=entry_digests,
+            entry_bytes=entry_bytes)
+        stream.write_bytes_atomic(
+            fmt.manifest_path(dir_path),
+            json.dumps(man, sort_keys=True).encode("utf-8"))
+    return mine, set_digest

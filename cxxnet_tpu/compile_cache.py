@@ -1,0 +1,121 @@
+"""Persistent XLA compile cache (doc/tasks.md "Sharded checkpointing").
+
+Restart latency is the scale tax ROADMAP item 4 names: an elastic
+takeover, a serve replica cold-start, or a plain resume pays checkpoint
+restore PLUS a full recompile of every step/eval/serve executable. The
+restore half is what the shard sets fix; this module removes the
+recompile half by pointing JAX's persistent compilation cache at a
+validated ``compile_cache_dir`` — the second process of a warm restart
+loads serialized executables instead of re-running XLA.
+
+Observability (the ``cxxnet_compile_cache`` tag): enabling lands a
+``compile_cache`` ledger event and a ``cxxnet_compile_cache_info{dir}``
+info-gauge; every persistent-cache hit counts into
+``cxxnet_compile_cache_hits_total`` AND lands a
+``compile_cache`` ledger event with ``hit=true``. That pairing is what
+lets the PR-7 recompile-storm detector's operator distinguish
+cold-start from storm: real XLA builds for a window are (compile
+events - cache-hit events) — on jax builds where the
+``backend_compile`` duration event wraps the cached path too (0.4.x),
+``cxxnet_compiles_total`` alone over-counts a warm restart, while the
+hits series climbing in lockstep marks the burst as cache-served
+cold-start, not recompilation.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from .telemetry.ledger import LEDGER
+from .telemetry.registry import REGISTRY
+
+_LOCK = threading.Lock()
+_ENABLED_DIR = ""
+_HIT_LISTENER_INSTALLED = False
+
+
+def enable_compile_cache(cache_dir: str, silent: bool = True) -> bool:
+    """Point JAX's persistent compilation cache at ``cache_dir`` and
+    install the cache-hit counter. Idempotent (re-enable with the same
+    dir is a no-op; a different dir re-points the cache). Returns False
+    when this jax build has no compilation-cache config — the run
+    proceeds uncached, degrade-don't-die like every observability
+    path."""
+    global _ENABLED_DIR
+    if not cache_dir:
+        return False
+    cache_dir = os.path.abspath(cache_dir)
+    with _LOCK:
+        already = _ENABLED_DIR == cache_dir
+    if already:
+        return True
+    import jax
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_enable_compilation_cache", True)
+        # cache EVERY executable: the default min-compile-time gate
+        # (1s) would skip exactly the many small serve-bucket / eval
+        # executables whose recompile storm the detector measures
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+        try:
+            jax.config.update(
+                "jax_persistent_cache_min_entry_size_bytes", -1)
+        except Exception:       # knob absent on some versions: fine
+            pass
+    except Exception as e:
+        if not silent:
+            print(f"compile cache: SKIP ({type(e).__name__}: {e}) — "
+                  "this jax has no persistent compilation cache",
+                  flush=True)
+        return False
+    with _LOCK:
+        _ENABLED_DIR = cache_dir
+    installed = _install_hit_listener()
+    REGISTRY.gauge(
+        "cxxnet_compile_cache_info",
+        "Persistent compile cache identity (constant 1)",
+        labels=("dir",)).labels(cache_dir).set(1)
+    LEDGER.event("compile_cache", dir=cache_dir, enabled=True,
+                 hit_counter=installed)
+    if not silent:
+        print(f"compile cache: persistent executables in {cache_dir}",
+              flush=True)
+    return True
+
+
+def cache_dir() -> str:
+    """The enabled cache directory ('' when off)."""
+    with _LOCK:
+        return _ENABLED_DIR
+
+
+def _install_hit_listener() -> bool:
+    """Count ``/jax/compilation_cache/cache_hits`` monitoring events
+    into ``cxxnet_compile_cache_hits_total``. Idempotent; False when
+    this jax has no monitoring listener API."""
+    global _HIT_LISTENER_INSTALLED
+    if _HIT_LISTENER_INSTALLED:
+        return True
+    try:
+        from jax import monitoring
+        register = monitoring.register_event_listener
+    except Exception:
+        return False
+    c = REGISTRY.counter(
+        "cxxnet_compile_cache_hits_total",
+        "Persistent-compile-cache hits (executables NOT recompiled)")
+
+    def _on_event(event: str, **kw) -> None:
+        if event.endswith("compilation_cache/cache_hits"):
+            c.inc()
+            LEDGER.event("compile_cache", hit=True)
+
+    try:
+        register(_on_event)
+    except Exception:
+        return False
+    _HIT_LISTENER_INSTALLED = True
+    return True

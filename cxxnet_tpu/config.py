@@ -387,6 +387,54 @@ def parse_sharding_config(cfg: ConfigPairs) -> ShardingConfig:
     return sc
 
 
+# -- checkpoint format + compile cache ----------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class CkptConfig:
+    """The sharded-checkpoint / persistent-compile-cache knob set
+    (doc/tasks.md "Sharded checkpointing"). One validated namespace,
+    same contract as ``serve_*`` / ``telemetry_*``: a typo'd key raises
+    instead of silently checkpointing in the wrong format."""
+    shard_ckpt: int = 0          # shard_ckpt: 1 = rounds are shard SETS
+    shard_ckpt_shards: int = 0   # shard_ckpt_shards: files per set
+    #                              (0 = auto: one per jax process)
+    compile_cache_dir: str = ""  # compile_cache_dir: persistent XLA
+    #                              executable cache ('' = off)
+
+
+def parse_ckpt_config(cfg: ConfigPairs) -> CkptConfig:
+    """Collect/validate the ``shard_ckpt*`` / ``compile_cache_dir``
+    keys (last occurrence wins; unknown keys in the namespace fail
+    fast)."""
+    known = {
+        "shard_ckpt": ("shard_ckpt", int),
+        "shard_ckpt_shards": ("shard_ckpt_shards", int),
+        "compile_cache_dir": ("compile_cache_dir", str),
+    }
+    vals = {}
+    for name, val in cfg:
+        if name.startswith("shard_ckpt") or \
+                name.startswith("compile_cache"):
+            if name not in known:
+                raise ConfigError(
+                    f"unknown checkpoint setting {name!r}; valid keys: "
+                    + ", ".join(sorted(known)))
+            field, conv = known[name]
+            try:
+                vals[field] = conv(val)
+            except ValueError as e:
+                raise ConfigError(f"bad {name} value {val!r}: {e}")
+    cc = CkptConfig(**vals)
+    if cc.shard_ckpt not in (0, 1):
+        raise ConfigError(
+            f"shard_ckpt must be 0 or 1, got {cc.shard_ckpt}")
+    if cc.shard_ckpt_shards < 0:
+        raise ConfigError(
+            f"shard_ckpt_shards must be >= 0 (0 = one per process), "
+            f"got {cc.shard_ckpt_shards}")
+    return cc
+
+
 # -- elastic training ---------------------------------------------------------
 
 @dataclasses.dataclass(frozen=True)

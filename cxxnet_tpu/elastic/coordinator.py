@@ -397,8 +397,12 @@ class ElasticCoordinator:
         (default: ``grace_s``). Closes the two-writers window on a
         join-triggered leadership change; a LOSS-triggered change has
         no old writer left, so this returns immediately. Returns False
-        on timeout (proceed anyway — checkpoint writes are atomic, so
-        the worst case is one orphaned round file, not corruption)."""
+        on timeout (proceed anyway — blob writes are atomic and a shard
+        set is only published by its manifest-last write, so the worst
+        case is one orphaned round file or a quorum-rejected partial
+        set, not corruption; the demoted leader drains its async save —
+        shard staging included — BEFORE acking, main.py's handover
+        path)."""
         deadline = self.clock() + (self.grace_s if timeout_s is None
                                    else timeout_s)
         while True:

@@ -53,6 +53,10 @@ def resume_latest(trainer, model_dir: str, *, silent: bool = True,
                                     verbose=not silent)
     if latest is None:
         return None
+    # the scan is format-agnostic: a shard-set round (r%04d/) is
+    # QUORUM-validated — the torn set a SIGKILLed leader left behind
+    # fails it and the takeover falls back a round, exactly like a
+    # torn blob (tools/smoke_shardckpt.py is the proof)
     r, path, blob = latest
     restore_blob(trainer, blob, path=path)
     if not silent:
@@ -76,7 +80,8 @@ def restore_blob(trainer, blob: Dict[str, Any], path: str = "") -> None:
                  step_count=int(m.get("step_count", 0)),
                  lr_scale=float(m.get("lr_scale", 1.0)),
                  dp=trainer.mesh.data_parallel,
-                 devices=trainer.mesh.num_devices)
+                 devices=trainer.mesh.num_devices,
+                 format="shard" if m.get("n_shards") else "blob")
 
 
 def reshard_tree(tree, old_ctx, new_ctx, old_specs, new_specs

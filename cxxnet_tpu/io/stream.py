@@ -188,15 +188,17 @@ def getmtime(path: str) -> float:
 _TMP_SEQ = itertools.count()
 
 def is_own_tmp(filename: str) -> bool:
-    """Whether a directory entry is a tmp file of THIS process —
+    """Whether a directory entry (basename or full path — the shard-set
+    sweep walks round subdirectories) is a tmp file of THIS process —
     ``<name>.tmp.<pid>`` (legacy, pre-thread-unique) or
     ``<name>.tmp.<pid>.<seq>``. The orphan sweeps
     (checkpoint.find_latest_valid) must never delete them — an async
-    save thread may be mid-write; only the protocol owner here knows
-    the naming scheme. Compiled per call so a forked child never
-    reuses its parent's pid."""
+    save thread may be mid-write on a blob OR on one of its shard
+    files; only the protocol owner here knows the naming scheme.
+    Compiled per call so a forked child never reuses its parent's
+    pid."""
     return re.search(r"\.tmp\.%d(\.\d+)?$" % os.getpid(),
-                     filename) is not None
+                     os.path.basename(filename)) is not None
 
 
 def write_bytes_atomic(path: str, data: bytes) -> None:

@@ -19,9 +19,9 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .config import (ConfigPairs, parse_cli_overrides, parse_config_file,
-                     parse_elastic_config, parse_retry_policy,
-                     parse_telemetry_config)
+from .config import (ConfigPairs, parse_cli_overrides, parse_ckpt_config,
+                     parse_config_file, parse_elastic_config,
+                     parse_retry_policy, parse_telemetry_config)
 from .graph import global_param
 from .io.data import DataBatch, create_iterator
 from .resilience import SentinelAbort, TrainingSentinel, counters, failpoints
@@ -126,6 +126,11 @@ class LearnTask:
         stream.set_retry_policy(parse_retry_policy(self.global_cfg))
         # checkpoint hygiene: keep only the newest N (0 = keep all)
         self.keep_last_n = int(gp("keep_last_n", "0"))
+        # sharded checkpointing + persistent compile cache (doc/tasks.md
+        # "Sharded checkpointing"): shard_ckpt routes through the
+        # Trainer's knob; compile_cache_dir is enabled below once the
+        # telemetry session exists (its ledger event must land)
+        self.ckpt_cfg = parse_ckpt_config(self.global_cfg)
         # -- telemetry (doc/tasks.md "Telemetry") -------------------------
         # telemetry_trace / telemetry_port / telemetry_log /
         # telemetry_profile_steps / telemetry_sync_interval — one
@@ -235,6 +240,14 @@ class LearnTask:
         self.telemetry = TelemetrySession(
             self.telemetry_cfg, silent=bool(self.silent),
             cfg_hash=config_hash(self.cfg), host=self._tel_host)
+        # persistent compile cache BEFORE the first executable builds
+        # (train step fns, serve buckets): warm restarts — elastic
+        # takeovers, replica cold-starts, continue=1 — deserialize
+        # instead of recompiling (cxxnet_compile_cache_hits_total)
+        if self.ckpt_cfg.compile_cache_dir:
+            from .compile_cache import enable_compile_cache
+            enable_compile_cache(self.ckpt_cfg.compile_cache_dir,
+                                 silent=bool(self.silent))
         self.trainer = Trainer(self.global_cfg)
         # the hang watchdog's progress source upgrades to the trainer's
         # own step counter — it advances even with the step-time probe
@@ -246,6 +259,7 @@ class LearnTask:
         # run_start anchors the ledger: identity + config + the mesh
         # this process actually brought up
         from .parallel import mesh as mesh_mod
+        from .compile_cache import cache_dir
         m = self.trainer.mesh
         LEDGER.event(
             "run_start", task=self.task,
@@ -255,7 +269,8 @@ class LearnTask:
             devices=m.num_devices, platform=jax.devices()[0].platform,
             mesh={"data": m.data_parallel, "seq": m.seq_parallel,
                   "pipe": m.pipeline_parallel, "model": m.model_parallel},
-            dist=mesh_mod.LAST_DIST_INIT)
+            dist=mesh_mod.LAST_DIST_INIT,
+            compile_cache=cache_dir())
 
     # -- iterators ---------------------------------------------------------
     def _make_iter(self, pairs: ConfigPairs):
@@ -397,7 +412,6 @@ class LearnTask:
         exiting 0 without the artifact the run exists to produce would
         be a lie."""
         if self.save_model and not self.test_io:
-            from .io import stream
             try:
                 tr.wait_saves()
             except RuntimeError as e:
@@ -407,10 +421,23 @@ class LearnTask:
                           "attempting the final save anyway", flush=True)
             # the last round actually RUN (max_round may cap below
             # num_round)
-            final = ckpt.model_path(
+            final = tr.checkpoint_path(
                 self.model_dir,
                 getattr(self, "_end_round", self.num_round) - 1)
-            if not stream.exists(final):
+            have = ckpt.checkpoint_exists(final)
+            import jax
+            if jax.process_count() > 1:
+                # save_model's gathers are cross-host collectives, so
+                # every rank must take the same branch — and the
+                # filesystem answer is rank-divergent by construction
+                # (rank 0 publishes the blob/manifest while peers are
+                # already past their writes). Agree: re-save unless
+                # EVERY rank sees the final checkpoint.
+                from jax.experimental import multihost_utils
+                haves = np.asarray(multihost_utils.process_allgather(
+                    np.int32(1 if have else 0))).ravel()
+                have = bool(haves.min())
+            if not have:
                 tr.save_model(final)
         tr.wait_saves()
 
@@ -497,8 +524,13 @@ class LearnTask:
                     # actually covers THIS config's rounds — a
                     # leftover complete=true in a reused elastic_dir
                     # (earlier, shorter run) must reopen, not silently
-                    # exit 0 with rounds untrained
-                    latest = ckpt.find_latest(self.model_dir)
+                    # exit 0 with rounds untrained. The VALIDATING
+                    # scan, not the cheap one: a shard-set manifest
+                    # whose set cannot actually load (a peer died
+                    # between its shards and the publish) must not
+                    # count as completion evidence.
+                    latest = ckpt.find_latest_valid(self.model_dir,
+                                                    sweep_tmp=False)
                     if latest is not None \
                             and latest[0] >= self.num_round - 1:
                         coord.leave("complete")
@@ -648,15 +680,14 @@ class LearnTask:
         still the leader: a demoted standby must not overwrite its
         successor's rounds), immediate departure notice, exit 0 — a
         preemption is a normal lifecycle event, not a crash."""
-        from .io import stream
         st = coord.read_state()
         r = self._cur_round
         if (tr is not None and tr.params is not None and r is not None
                 and self.save_model and st is not None
                 and st.leader == coord.worker
                 and preempt.remaining_s() > 0):
-            path = ckpt.model_path(self.model_dir, r)
-            if not stream.exists(path):
+            path = tr.checkpoint_path(self.model_dir, r)
+            if not ckpt.checkpoint_exists(path):
                 # partial-round params saved AS round r: the successor
                 # resumes at r+1 — freshness over strict determinism
                 # inside the preempted round (doc/elastic_runbook.md)
@@ -759,7 +790,7 @@ class LearnTask:
                       flush=True)
             return
         try:
-            tr.save_model(ckpt.model_path(self.model_dir, r))
+            tr.save_model(tr.checkpoint_path(self.model_dir, r))
         except Exception as e:
             counters.inc("ckpt.write_failures")
             if self._is_root:

@@ -24,20 +24,25 @@ Modes per site:
 
 Sites installed in this codebase:
 
-=================  ========================================================
-``ckpt.write``     checkpoint.save_model, before the archive is written
-``io.write``       io.stream.write_bytes_atomic, after the tmp file is
-                   written but before the atomic rename (leaves a ``.tmp``
-                   orphan — the crash the resume sweep must clean up)
-``io.open``        io.stream.sopen
-``io.read``        io.stream read path (wrapped file objects / read_bytes)
-``record.decode``  io.recordio.RecordReader payload decode
-``device.step``    trainer.Trainer.update, after the device step (poisons
-                   params + loss with NaN — the loss-spike the sentinel
-                   must catch and roll back)
-``serve.infer``    serve.engine.InferenceEngine.run_padded (a failing
-                   device dispatch — what trips the serve circuit breaker)
-=================  ========================================================
+====================  =====================================================
+``ckpt.write``        checkpoint.save_model / ckpt_sharded.save_shard_set,
+                      before anything is written
+``ckpt.shard_write``  ckpt_sharded.writer, before EACH shard file write —
+                      tears a single shard of a set deterministically
+                      (the quorum-rejection chaos tests)
+``io.write``          io.stream.write_bytes_atomic, after the tmp file is
+                      written but before the atomic rename (leaves a
+                      ``.tmp`` orphan — the crash the resume sweep must
+                      clean up)
+``io.open``           io.stream.sopen
+``io.read``           io.stream read path (wrapped files / read_bytes)
+``record.decode``     io.recordio.RecordReader payload decode
+``device.step``       trainer.Trainer.update, after the device step
+                      (poisons params + loss with NaN — the loss-spike
+                      the sentinel must catch and roll back)
+``serve.infer``       serve.engine.InferenceEngine.run_padded (a failing
+                      device dispatch — what trips the serve breaker)
+====================  =====================================================
 """
 
 from __future__ import annotations

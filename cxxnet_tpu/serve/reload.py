@@ -14,6 +14,15 @@ mid-write or truncated checkpoint can never be served. The cheap
 read+verify only happens when the directory actually has a newer round
 than the pool serves.
 
+Shard-set rounds ride the same two scans: ``find_latest`` counts a
+``r%04d/`` directory only once its manifest is published (an
+in-progress set never even triggers the verify), and
+``find_latest_valid`` quorum-validates the whole set before any replica
+is touched (``load_for_inference`` additionally skips all-optimizer
+shard files when an engine restores directly from a path).
+``blob_digest`` over a shard-set meta equals the same state's blob
+digest, so version/digest labels stay format-independent.
+
 A/B pinning rides the same path: with ``ab_replicas = k``, a reload
 updates only the k-replica canary subset, leaving the rest on the
 previous version — two model versions serve side by side (per-version

@@ -58,8 +58,9 @@ _MAX_LINE_BYTES = 3584
 # doc/tasks.md "Fleet observability"); readers MUST also accept types
 # not listed here — the schema is open-world by contract
 KNOWN_EVENTS = (
-    "run_start", "run_end", "round_end", "compile",
-    "ckpt_save", "ckpt_load", "rollback", "sentinel_trip",
+    "run_start", "run_end", "round_end", "compile", "compile_cache",
+    "ckpt_save", "ckpt_load", "ckpt_shard_write", "rollback",
+    "sentinel_trip",
     "breaker_transition", "hang_dump", "straggler", "recompile_storm",
     # serving fleet (serve/fleet.py, serve/reload.py, serve/server.py)
     "serve_start", "weights_reload", "replica_state",

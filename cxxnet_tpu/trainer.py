@@ -161,11 +161,20 @@ class Trainer:
         self.eval_train = int(gp("eval_train", "1"))
         self.seed = int(gp("seed", "0"))
         self.silent = int(gp("silent", "0"))
-        # save_async = 1: checkpoint file IO happens on a background thread
-        # (device->host gather stays synchronous); training resumes while
-        # the previous checkpoint is still being written
+        # save_async = 1: checkpoint IO — including the device->host
+        # staging transfer — happens on a background thread; the
+        # critical path pays one async device-copy dispatch and
+        # training resumes while the previous checkpoint is written
         self.save_async = int(gp("save_async", "0"))
         self._save_thread = None
+        # sharded checkpointing (doc/tasks.md "Sharded checkpointing"):
+        # rounds write as r%04d/ shard SETS instead of one blob; layout
+        # derives from the partition rules, resume quorum-validates
+        from .config import parse_ckpt_config
+        _ckpt_cfg = parse_ckpt_config(cfg)
+        self.shard_ckpt = _ckpt_cfg.shard_ckpt
+        self.shard_ckpt_shards = _ckpt_cfg.shard_ckpt_shards
+        self._warned_no_ckpt_barrier = False
         dev = gp("dev", "")
         model_parallel = int(gp("model_parallel", "1"))
         seq_parallel = int(gp("seq_parallel", "1"))
@@ -502,35 +511,140 @@ class Trainer:
             params, net_state, self.optimizer.init_state(params))
         self._init_accum(params)
 
+    def _checkpoint_sharded(self, path: str) -> bool:
+        """Whether this save/exists check targets a shard-set round —
+        the knob decides, but an explicit ``.model`` path (model_out,
+        import tools) always stays a blob."""
+        return bool(self.shard_ckpt) and not path.endswith(".model")
+
+    def checkpoint_path(self, model_dir: str, round_counter: int) -> str:
+        """Round path in this trainer's configured checkpoint format."""
+        return ckpt.checkpoint_path(model_dir, round_counter,
+                                    sharded=bool(self.shard_ckpt))
+
+    def _shard_spec_map(self, params):
+        """{flat_array_path: PartitionSpec} over the params AND
+        optimizer-state groups — the same rule-driven spec trees
+        placement uses, flattened to the checkpoint's path namespace so
+        the shard writer chunks each leaf along its device-sharded dim
+        (parallel/rules.py is the single source of truth for both)."""
+        from .parallel.rules import tree_paths
+        is_spec = lambda v: isinstance(v, tuple)
+        out = {}
+        pspecs = self._param_pspecs(params)
+        for prefix, tree in (("params", pspecs),
+                             ("opt", self.optimizer.state_pspecs(pspecs))):
+            pairs, _ = tree_paths(tree, is_leaf=is_spec)
+            for p, spec in pairs:
+                out[f"{prefix}/{p}"] = spec
+        return out
+
+    def _ckpt_barrier(self, world: int):
+        """Cross-rank 'all shards durable' barrier for the shard-set
+        writer's manifest-last publish: the jax coordination-service
+        wait (a TCP barrier — no device collective, so it is safe on
+        the async writer thread while the main thread keeps dispatching
+        steps). None on single-controller runs; None with a one-time
+        warning when this jax exposes no distributed client (the
+        manifest may then race a slower peer's shard write — readers
+        quorum-reject the incomplete set either way)."""
+        if world <= 1:
+            return None
+        try:
+            from jax._src import distributed
+            client = distributed.global_state.client
+            if client is None:
+                raise RuntimeError("no distributed client")
+        except Exception as e:
+            if not self._warned_no_ckpt_barrier:
+                self._warned_no_ckpt_barrier = True
+                print(f"WARNING: no coordination-service barrier for "
+                      f"sharded checkpoint publishes "
+                      f"({type(e).__name__}: {e}); a manifest may race "
+                      "a slower rank's shard write (readers quorum-"
+                      "reject the incomplete set)", flush=True)
+            return None
+        # pin the id NOW: under save_async the barrier runs on the
+        # writer thread while the main thread keeps stepping, so a
+        # late read of the live counters would give every rank a
+        # different barrier name and time every publish out
+        bid = f"cxxnet_ckpt_{self.round_counter}_{self._step_count}"
+
+        def barrier():
+            # id unique per save and identical across ranks (round +
+            # step at save time pin it); a dead peer times out -> the
+            # writer publishes anyway with a warning
+            client.wait_at_barrier(bid, 120_000)
+        return barrier
+
+    @staticmethod
+    def _stage_copy(tree):
+        """Device-side copies of a checkpoint tree, dispatched
+        asynchronously: fresh buffers the next step's donation cannot
+        delete, so the device->host transfer itself can move to the
+        async writer thread (save_async staging off the critical
+        path). Non-device leaves copy on the host."""
+        return jax.tree_util.tree_map(
+            lambda x: jnp.copy(x) if isinstance(x, jax.Array)
+            else np.array(x), tree)
+
     def save_model(self, path: str) -> None:
         # the gathers are cross-host collectives when params are
-        # model-sharded: every rank must execute them; only rank 0 writes
+        # model-sharded: every rank must execute them; only rank 0
+        # writes a blob, while shard mode has EVERY rank write its own
+        # shard files (rank 0 adds the manifest, last)
         params = self.mesh.gather(self.params)
         opt = self.mesh.gather(self.opt_state)
-        if jax.process_index() != 0:
+        rank, world = jax.process_index(), jax.process_count()
+        sharded = self._checkpoint_sharded(path)
+        if not sharded and rank != 0:
             return
         kwargs = dict(
             structure_sig=self.graph.structure_signature(),
             round_counter=self.round_counter, epoch_counter=self.epoch_counter,
-            params=params, net_state=self.net_state, opt_state=opt,
             step_count=self._step_count,
             lr_scale=self.optimizer.lr_scale)
+        if sharded:
+            from .ckpt_sharded import save_shard_set
+            writer = save_shard_set
+            kwargs.update(
+                n_shards=self.shard_ckpt_shards or max(world, 1),
+                spec_map=self._shard_spec_map(params),
+                rank=rank, world=world,
+                barrier=self._ckpt_barrier(world))
+        else:
+            writer = ckpt.save_model
         if not self.save_async:
-            ckpt.save_model(path, **kwargs)
+            kwargs.update(params=params, net_state=self.net_state,
+                          opt_state=opt)
+            writer(path, **kwargs)
             return
-        # host copies of EVERY device tree before handing off: the jitted
-        # train step donates params/opt_state/net_state, so the next
-        # update() would delete the buffers under the writer thread
-        kwargs["params"] = ckpt.jax_to_numpy(params)
-        kwargs["opt_state"] = ckpt.jax_to_numpy(opt)
-        kwargs["net_state"] = ckpt.jax_to_numpy(self.net_state)
+        # drain the previous in-flight save BEFORE staging this one:
+        # staging memory stays bounded at one checkpoint's copies
         self.wait_saves()
+        if world > 1:
+            # multi-controller: host copies on the caller thread (the
+            # conservative path — staged device copies of global arrays
+            # are backend-dependent); the file IO still overlaps
+            kwargs.update(params=ckpt.jax_to_numpy(params),
+                          opt_state=ckpt.jax_to_numpy(opt),
+                          net_state=ckpt.jax_to_numpy(self.net_state))
+        else:
+            # fully-overlapped staging: device-side copies dispatch
+            # async here (fresh buffers donation cannot delete); the
+            # device->host transfer AND the archive write happen on the
+            # background thread. Memory is bounded to ONE staged
+            # checkpoint — wait_saves() below drains the previous save
+            # before this one stages.
+            kwargs.update(params=self._stage_copy(params),
+                          opt_state=self._stage_copy(opt),
+                          net_state=self._stage_copy(self.net_state))
         import threading
         err: List[BaseException] = []
 
         def _write():
             try:
-                ckpt.save_model(path, **kwargs)
+                writer(path, **kwargs)
             except BaseException as e:      # surfaced by wait_saves()
                 err.append(e)
 

@@ -80,9 +80,11 @@ QUICK_MODULES = {
     "test_binpage.py",
     "test_capi.py",
     "test_config.py",
-    # test_elastic.py is NOT module-listed: its fast protocol tests
-    # carry explicit @pytest.mark.quick marks, while the multi-run
-    # LearnTask / multi-device resume tests stay out of the tier
+    # test_elastic.py and test_shard_ckpt.py are NOT module-listed:
+    # their fast protocol/format tests carry explicit
+    # @pytest.mark.quick marks, while the multi-run LearnTask /
+    # subprocess (compile-cache warm restart) tests stay out of the
+    # tier
     "test_fused_stem_pool.py",
     "test_graph.py",
     "test_import_cxxnet.py",

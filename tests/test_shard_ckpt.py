@@ -1,0 +1,461 @@
+"""Sharded, quorum-validated checkpointing (cxxnet_tpu/ckpt_sharded/).
+
+Pins the ISSUE-12 contracts: a shard set round-trips bit-exactly and
+shares its content digest with the blob format; quorum validation
+rejects a missing shard, a flipped byte, a manifest/shard generation
+mismatch, and a manifest-less (torn) set — each falling back a round
+exactly like the blob path; blob rounds still load and mixed
+blob/shard model_dirs resolve to the newest valid of either format;
+rotation deletes whole round directories; the orphan sweep never reaps
+a live writer's in-progress files; the ``ckpt.shard_write`` failpoint
+tears a single set deterministically; the fully-async save stages
+device->host off the critical path; and a warm restart through the
+persistent compile cache builds strictly fewer executables.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu import checkpoint as ckpt
+from cxxnet_tpu import ckpt_sharded
+from cxxnet_tpu.ckpt_sharded import format as shard_fmt
+from cxxnet_tpu.config import (ConfigError, parse_ckpt_config,
+                               parse_config_string)
+from cxxnet_tpu.resilience import failpoints
+from cxxnet_tpu.telemetry.ledger import LEDGER
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SIG = ("layer", "fullc", [1, 2])
+
+
+def _state(seed=0, scale=1.0):
+    rng = np.random.RandomState(seed)
+    params = {"fc1": {"wmat": (rng.randn(8, 16) * scale).astype(
+        np.float32), "bias": rng.randn(16).astype(np.float32)}}
+    net_state = {"bn1": {"mean": rng.randn(16).astype(np.float32)}}
+    opt = {"mom": {"fc1": {"wmat": rng.randn(8, 16).astype(np.float32),
+                           "bias": rng.randn(16).astype(np.float32)}}}
+    return params, net_state, opt
+
+
+def _save(td, r, seed=0, n_shards=2, spec_map=None, **kw):
+    params, net_state, opt = _state(seed)
+    path = ckpt.checkpoint_path(td, r, sharded=True)
+    ckpt_sharded.save_shard_set(
+        path, structure_sig=SIG, round_counter=r, epoch_counter=r,
+        params=params, net_state=net_state, opt_state=opt,
+        step_count=10 * r, lr_scale=0.5, n_shards=n_shards,
+        spec_map=spec_map, **kw)
+    return path
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.clear()
+    yield
+    failpoints.clear()
+
+
+@pytest.mark.quick
+def test_roundtrip_and_blob_digest_parity(tmp_path):
+    td = str(tmp_path)
+    params, net_state, opt = _state(3)
+    shard_path = _save(td, 1, seed=3, n_shards=3)
+    blob_path = ckpt.model_path(td, 1)
+    ckpt.save_model(blob_path, structure_sig=SIG, round_counter=1,
+                    epoch_counter=1, params=params, net_state=net_state,
+                    opt_state=opt, step_count=10, lr_scale=0.5)
+    b_shard = ckpt.load_model(shard_path)
+    b_blob = ckpt.load_model(blob_path)
+    for group in ("params", "state", "opt"):
+        fa = jax_leaves(b_shard[group])
+        fb = jax_leaves(b_blob[group])
+        assert len(fa) == len(fb) > 0
+        for a, b in zip(fa, fb):
+            assert a.dtype == b.dtype and np.array_equal(a, b)
+    # content digests compare ACROSS formats: same state, same id
+    assert ckpt.blob_digest(b_shard["meta"]) \
+        == ckpt.blob_digest(b_blob["meta"]) != ""
+    # restore fields carried like the blob meta
+    m = b_shard["meta"]
+    assert (m["round"], m["step_count"], m["lr_scale"]) == (1, 10, 0.5)
+    ckpt.check_structure(m, SIG)
+
+
+def jax_leaves(tree):
+    import jax
+    return jax.tree_util.tree_leaves(tree)
+
+
+@pytest.mark.quick
+def test_missing_shard_falls_back_a_round(tmp_path):
+    td = str(tmp_path)
+    _save(td, 0, seed=0)
+    p1 = _save(td, 1, seed=1)
+    os.remove(os.path.join(p1, shard_fmt.shard_filename(1, 2)))
+    with pytest.raises(ckpt.CheckpointCorrupt):
+        ckpt.verify_model(p1)
+    latest = ckpt.find_latest_valid(td)
+    assert latest is not None and latest[0] == 0
+
+
+@pytest.mark.quick
+def test_flipped_byte_rejects_that_set_only(tmp_path):
+    """One flipped byte in one shard -> CheckpointCorrupt on that set
+    (via the per-entry digest, not just zip CRC) -> fallback."""
+    td = str(tmp_path)
+    _save(td, 0, seed=0)
+    p1 = _save(td, 1, seed=1)
+    # rebuild a shard with one array perturbed but a CONSISTENT zip:
+    # only the sha256 digests can catch it
+    fn = os.path.join(p1, shard_fmt.shard_filename(0, 2))
+    with np.load(fn, allow_pickle=False) as z:
+        arrays = {k: np.array(z[k]) for k in z.files}
+    name = next(k for k in arrays if k != "__shard_meta__")
+    arrays[name] = arrays[name].copy()
+    arrays[name].flat[0] += 1.0
+    import io as _io
+    buf = _io.BytesIO()
+    np.savez(buf, **arrays)
+    with open(fn, "wb") as f:
+        f.write(buf.getvalue())
+    with pytest.raises(ckpt.CheckpointCorrupt) as ei:
+        ckpt.verify_model(p1)
+    assert "digest mismatch" in str(ei.value)
+    latest = ckpt.find_latest_valid(td)
+    assert latest is not None and latest[0] == 0
+
+
+@pytest.mark.quick
+def test_generation_mismatch_rejected(tmp_path):
+    """A stale shard from an older (torn) write mixed under a newer
+    manifest is rejected: the embedded generation disagrees."""
+    td = str(tmp_path)
+    p0 = _save(td, 0, seed=0)
+    p1 = _save(td, 1, seed=1)
+    # same tree -> same entry names and file names across rounds, but
+    # different content -> different generation
+    fn = shard_fmt.shard_filename(0, 2)
+    with open(os.path.join(p0, fn), "rb") as f:
+        stale = f.read()
+    with open(os.path.join(p1, fn), "wb") as f:
+        f.write(stale)
+    with pytest.raises(ckpt.CheckpointCorrupt) as ei:
+        ckpt.verify_model(p1)
+    assert "generation" in str(ei.value)
+    latest = ckpt.find_latest_valid(td)
+    assert latest is not None and latest[0] == 0
+
+
+@pytest.mark.quick
+def test_manifestless_set_invisible_to_cheap_scan(tmp_path):
+    """An unpublished (in-progress or torn) set never counts as a
+    newer round for the cheap scan the serve reload watcher gates on;
+    the validating scan skips it with a counted fallback."""
+    td = str(tmp_path)
+    _save(td, 0, seed=0)
+    p1 = _save(td, 1, seed=1)
+    os.remove(shard_fmt.manifest_path(p1))
+    assert ckpt.find_latest(td)[0] == 0
+    assert ckpt.find_latest_valid(td)[0] == 0
+
+
+@pytest.mark.quick
+def test_mixed_blob_and_shard_resolve_newest_valid(tmp_path):
+    td = str(tmp_path)
+    params, net_state, opt = _state(7)
+    ckpt.save_model(ckpt.model_path(td, 3), structure_sig=SIG,
+                    round_counter=3, epoch_counter=3, params=params,
+                    net_state=net_state, opt_state=opt)
+    p4 = _save(td, 4, seed=4)
+    assert ckpt.find_latest_valid(td)[0] == 4
+    # corrupt the shard round -> the blob round wins
+    os.remove(os.path.join(p4, shard_fmt.shard_filename(0, 2)))
+    r, path = ckpt.find_latest_valid(td)
+    assert r == 3 and path.endswith(".model")
+    # same round in BOTH formats: the shard set (fleet format) wins
+    _save(td, 3, seed=3)
+    r, path = ckpt.find_latest_valid(td)
+    assert r == 3 and not path.endswith(".model")
+
+
+@pytest.mark.quick
+def test_rotation_deletes_whole_round_dirs(tmp_path):
+    td = str(tmp_path)
+    for r in range(4):
+        _save(td, r, seed=r)
+    deleted = ckpt.rotate_checkpoints(td, 2)
+    assert sorted(os.path.basename(p) for p in deleted) \
+        == ["r0000", "r0001"]
+    assert not os.path.exists(os.path.join(td, "r0000"))
+    assert ckpt.find_latest_valid(td)[0] == 3
+
+
+@pytest.mark.quick
+def test_rotation_counts_rounds_not_entries(tmp_path):
+    """keep_last_n promises ROUNDS of rollback depth: a round present
+    in both formats counts once (both representations kept)."""
+    td = str(tmp_path)
+    params, net_state, opt = _state(3)
+    for r in range(4):
+        _save(td, r, seed=r)
+    ckpt.save_model(ckpt.model_path(td, 3), structure_sig=SIG,
+                    round_counter=3, epoch_counter=3, params=params,
+                    net_state=net_state, opt_state=opt)
+    deleted = ckpt.rotate_checkpoints(td, 2)
+    # rounds kept: 3 (both formats) and 2 — not just the two newest
+    # directory entries
+    assert sorted(os.path.basename(p) for p in deleted) \
+        == ["r0000", "r0001"]
+    assert os.path.exists(os.path.join(td, "r0002"))
+    assert os.path.exists(os.path.join(td, "r0003"))
+    assert os.path.exists(ckpt.model_path(td, 3))
+
+
+@pytest.mark.quick
+def test_sweep_spares_live_reaps_stale(tmp_path):
+    td = str(tmp_path)
+    _save(td, 0, seed=0)
+    old = time.time() - 2 * ckpt.TMP_SWEEP_MIN_AGE_S
+
+    def _mk(path, stale):
+        with open(path, "wb") as f:
+            f.write(b"x")
+        if stale:
+            os.utime(path, (old, old))
+
+    # a FRESH manifest-less round dir = a live writer's in-progress set
+    live = os.path.join(td, "r0002")
+    os.makedirs(live)
+    _mk(os.path.join(live, shard_fmt.shard_filename(0, 2)), stale=False)
+    # a STALE manifest-less round dir = a crash orphan
+    torn = os.path.join(td, "r0001")
+    os.makedirs(torn)
+    _mk(os.path.join(torn, shard_fmt.shard_filename(0, 2)), stale=True)
+    # stale tmp INSIDE a published round dir is reaped; own tmp spared
+    p0 = os.path.join(td, "r0000")
+    _mk(os.path.join(p0, "shard_00of02.bin.tmp.99999.1"), stale=True)
+    own = os.path.join(p0, f"shard_01of02.bin.tmp.{os.getpid()}.7")
+    _mk(own, stale=True)
+    # an EMPTY manifest-less dir (a live writer between makedirs and
+    # its first shard write) must survive on the DIRECTORY's age
+    empty = os.path.join(td, "r0003")
+    os.makedirs(empty)
+    assert ckpt.find_latest_valid(td)[0] == 0
+    assert os.path.isdir(live)                  # live writer untouched
+    assert os.path.isdir(empty)                 # just-created dir spared
+    assert not os.path.exists(torn)             # crash orphan reaped
+    assert not os.path.exists(
+        os.path.join(p0, "shard_00of02.bin.tmp.99999.1"))
+    assert os.path.exists(own)                  # our async writer's tmp
+
+
+@pytest.mark.quick
+def test_shard_write_failpoint_tears_single_set(tmp_path):
+    td = str(tmp_path)
+    _save(td, 0, seed=0)
+    failpoints.set_site("ckpt.shard_write", "once")
+    with pytest.raises(IOError):
+        _save(td, 1, seed=1)
+    # the aborted set never published a manifest: quorum-invisible
+    assert not os.path.exists(
+        shard_fmt.manifest_path(os.path.join(td, "r0001")))
+    assert ckpt.find_latest_valid(td)[0] == 0
+    # disarmed: the retried save of the same round publishes cleanly
+    _save(td, 1, seed=1)
+    assert ckpt.find_latest_valid(td)[0] == 1
+
+
+@pytest.mark.quick
+def test_rule_driven_chunking_roundtrip(tmp_path):
+    """A leaf whose partition spec shards dim 0 splits into chunk
+    entries (the file-level analog of its device sharding) and merges
+    back bit-exactly; replicated leaves stay whole."""
+    td = str(tmp_path)
+    spec_map = {"params/fc1/wmat": ("data",),    # shard dim 0
+                "params/fc1/bias": ()}           # replicated
+    p = _save(td, 0, seed=5, n_shards=2, spec_map=spec_map)
+    man = json.loads(open(shard_fmt.manifest_path(p)).read())
+    entries = [e for rec in man["shards"] for e in rec["entries"]]
+    chunked = [e for e in entries if "::" in e]
+    assert sorted(chunked) == [
+        "params/fc1/wmat::c0of2d0", "params/fc1/wmat::c1of2d0"]
+    blob = ckpt.load_model(p)
+    params, _, _ = _state(5)
+    assert np.array_equal(blob["params"]["fc1"]["wmat"],
+                          params["fc1"]["wmat"])
+
+
+@pytest.mark.quick
+def test_ledger_fields_and_report_section(tmp_path):
+    td = str(tmp_path)
+    ledger = os.path.join(td, "run.jsonl")
+    LEDGER.enable(ledger, "shard-test", host=0)
+    try:
+        _save(td, 0, seed=0, n_shards=2)
+    finally:
+        LEDGER.disable()
+    from cxxnet_tpu.telemetry.ledger import read_ledger
+    ev = read_ledger(ledger)
+    saves = [e for e in ev if e["event"] == "ckpt_save"]
+    assert saves and saves[-1]["format"] == "shard"
+    assert saves[-1]["shards"] == 2 and saves[-1]["ok"]
+    assert saves[-1]["set_digest"]
+    assert saves[-1]["manifest"].endswith("MANIFEST.json")
+    writes = [e for e in ev if e["event"] == "ckpt_shard_write"]
+    assert len(writes) == 2
+    assert all(w["bytes"] > 0 and w["seconds"] >= 0 for w in writes)
+    # the run report renders per-shard IO
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "report.py"),
+         "--ledger", ledger, "-o", os.path.join(td, "R.md")],
+        cwd=_REPO, capture_output=True)
+    assert out.returncode == 0, out.stderr
+    md = open(os.path.join(td, "R.md")).read()
+    assert "shard IO: 2 shard file(s)" in md
+    assert "wrote shard sets" in md
+
+
+@pytest.mark.quick
+def test_ckpt_config_validation():
+    cfg = parse_ckpt_config([("shard_ckpt", "1"),
+                             ("shard_ckpt_shards", "4"),
+                             ("compile_cache_dir", "/tmp/x")])
+    assert (cfg.shard_ckpt, cfg.shard_ckpt_shards,
+            cfg.compile_cache_dir) == (1, 4, "/tmp/x")
+    with pytest.raises(ConfigError):
+        parse_ckpt_config([("shard_ckpt_shard", "2")])     # typo'd key
+    with pytest.raises(ConfigError):
+        parse_ckpt_config([("compile_cache_size", "9")])   # typo'd key
+    with pytest.raises(ConfigError):
+        parse_ckpt_config([("shard_ckpt", "2")])
+    with pytest.raises(ConfigError):
+        parse_ckpt_config([("shard_ckpt_shards", "-1")])
+
+
+TRAIN_CFG = """
+netconfig=start
+layer[0->1] = fullc:fc1
+  nhidden = 32
+  init_sigma = 0.01
+layer[1->2] = relu:r1
+layer[2->3] = fullc:fc2
+  nhidden = 4
+  init_sigma = 0.01
+layer[3->3] = softmax
+netconfig=end
+input_shape = 1,1,16
+batch_size = 8
+eta = 0.1
+eval_train = 0
+"""
+
+
+def _batch(rng):
+    from cxxnet_tpu.io.data import DataBatch
+    return DataBatch(
+        data=rng.randn(8, 1, 1, 16).astype(np.float32),
+        label=rng.randint(0, 4, (8, 1)).astype(np.float32))
+
+
+@pytest.mark.quick
+def test_trainer_async_shard_save_resume_bitexact(mesh1, tmp_path):
+    """The fully-async save: device->host staging happens on the
+    writer thread over staged copies, so the next (donating) update
+    cannot tear the checkpoint — and the written set restores
+    bit-exactly."""
+    from cxxnet_tpu.trainer import Trainer
+    cfg = parse_config_string(TRAIN_CFG + "shard_ckpt = 1\n"
+                              "shard_ckpt_shards = 2\nsave_async = 1\n")
+    tr = Trainer(cfg, mesh_ctx=mesh1)
+    tr.init_model()
+    rng = np.random.RandomState(0)
+    for _ in range(3):
+        tr.update(_batch(rng))
+    td = str(tmp_path)
+    path = tr.checkpoint_path(td, 0)
+    tr.save_model(path)
+    # the save is in flight on the background thread; keep TRAINING
+    # (donates the live buffers) — the staged copies must be immune
+    expect = ckpt.jax_to_numpy(tr.mesh.gather(tr.params))
+    for _ in range(2):
+        tr.update(_batch(rng))
+    tr.wait_saves()
+    assert ckpt.checkpoint_exists(path)
+    tr2 = Trainer(cfg, mesh_ctx=mesh1)
+    tr2.load_model(path)
+    assert tr2._step_count == 3
+    import jax
+    for a, b in zip(jax.tree_util.tree_leaves(expect),
+                    jax.tree_util.tree_leaves(
+                        ckpt.jax_to_numpy(tr2.params))):
+        assert np.array_equal(a, b)
+
+
+@pytest.mark.quick
+def test_cross_width_restore_from_shard_set(mesh8, mesh1, tmp_path):
+    """A width-8 shard-set checkpoint restores bit-exactly onto width
+    1 through the rule-driven shard fns — the PR-10 topology-change
+    contract, now without a blob."""
+    from cxxnet_tpu.trainer import Trainer
+    cfg = parse_config_string(TRAIN_CFG + "shard_ckpt = 1\n"
+                              "shard_ckpt_shards = 2\n")
+    tr8 = Trainer(cfg, mesh_ctx=mesh8)
+    tr8.init_model()
+    rng = np.random.RandomState(1)
+    for _ in range(2):
+        tr8.update(_batch(rng))
+    td = str(tmp_path)
+    tr8.save_model(tr8.checkpoint_path(td, 0))
+    tr1 = Trainer(cfg, mesh_ctx=mesh1)
+    tr1.load_model(ckpt.find_latest_valid(td)[1])
+    import jax
+    for a, b in zip(
+            jax.tree_util.tree_leaves(ckpt.jax_to_numpy(
+                tr8.mesh.gather(tr8.opt_state))),
+            jax.tree_util.tree_leaves(ckpt.jax_to_numpy(
+                tr1.opt_state))):
+        assert np.array_equal(a, b)
+
+
+def test_compile_cache_warm_restart(tmp_path):
+    """The persistent compile cache: a second process over the same
+    cache dir performs strictly fewer REAL XLA builds (compile events
+    minus cache hits) and its hits counter moves — the ledger-level
+    cold-start signature the recompile-storm operator reads."""
+    td = str(tmp_path)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def run(tag):
+        ledger = os.path.join(td, f"{tag}.jsonl")
+        p = subprocess.run(
+            [sys.executable, "-m", "cxxnet_tpu.main",
+             os.path.join(_REPO, "examples", "synthetic_mlp.conf"),
+             "num_round=1", f"model_dir={os.path.join(td, tag)}",
+             f"compile_cache_dir={os.path.join(td, 'cache')}",
+             f"telemetry_ledger={ledger}", "silent=1"],
+            cwd=_REPO, env=env, capture_output=True, timeout=240)
+        assert p.returncode == 0, p.stdout[-2000:] + p.stderr[-2000:]
+        from cxxnet_tpu.telemetry.ledger import read_ledger
+        ev = read_ledger(ledger)
+        compiles = len([e for e in ev if e["event"] == "compile"])
+        hits = len([e for e in ev if e["event"] == "compile_cache"
+                    and e.get("hit")])
+        enabled = [e for e in ev if e["event"] == "compile_cache"
+                   and e.get("enabled")]
+        assert enabled and enabled[0]["dir"].endswith("cache")
+        return compiles, hits
+
+    c1, h1 = run("cold")
+    c2, h2 = run("warm")
+    assert h1 == 0 and c1 > 0
+    assert h2 > 0, "warm restart must hit the persistent cache"
+    assert c2 - h2 < c1 - h1, (c1, h1, c2, h2)

@@ -311,7 +311,9 @@ def section_topology(events: List[Dict], out: List[str]) -> None:
 def section_checkpoints(events: List[Dict], out: List[str]) -> None:
     saves = [e for e in events if e.get("event") == "ckpt_save"]
     loads = [e for e in events if e.get("event") == "ckpt_load"]
-    if not saves and not loads:
+    shard_writes = [e for e in events
+                    if e.get("event") == "ckpt_shard_write"]
+    if not saves and not loads and not shard_writes:
         return
     out.append("## Checkpoints")
     out.append("")
@@ -322,6 +324,16 @@ def section_checkpoints(events: List[Dict], out: List[str]) -> None:
         secs = sum(float(e.get("seconds", 0) or 0) for e in evs)
         out.append("- %d %s (%d failed), %.2fs total IO"
                    % (len(evs), name, len(bad), secs))
+    n_shard_saves = len([e for e in saves if e.get("format") == "shard"])
+    if n_shard_saves:
+        out.append("- %d save(s) wrote shard sets" % n_shard_saves)
+    if shard_writes:
+        mbs = [float(e.get("bytes", 0) or 0) / 1e6 for e in shard_writes]
+        ms = [1e3 * float(e.get("seconds", 0) or 0) for e in shard_writes]
+        out.append("- shard IO: %d shard file(s), %.1f MB total, "
+                   "%.1f/%.1f ms avg/max per shard"
+                   % (len(shard_writes), sum(mbs),
+                      sum(ms) / len(ms), max(ms)))
     out.append("")
 
 

@@ -1,0 +1,292 @@
+#!/usr/bin/env python
+"""Sharded-checkpoint chaos smoke (CPU-safe, multi-process) — ISSUE 12.
+
+The acceptance run for doc/tasks.md "Sharded checkpointing":
+
+  1. CONTROL: an uninterrupted single-process dp=1 run with
+     ``shard_ckpt=1`` — every round lands as a quorum-valid
+     ``r%04d/`` shard set; round losses + set digests recorded.
+  2. CHAOS: two elastic workers share one elastic_dir / model_dir /
+     ledger; worker 0 leads (lowest id), worker 1 is a warm standby.
+     Worker 0's shard writes are stalled (the writer's documented
+     ``CXXNET_SHARD_WRITE_STALL_S`` chaos hook) so the parent can
+     SIGKILL it deterministically MID-SHARD-SAVE — after a shard file
+     of round K landed but before the manifest published.
+  3. QUORUM REJECTION: the torn round-K set (shards, no manifest) is
+     asserted on disk; worker 1's takeover resume must quorum-reject
+     it and fall back to a round < K, then retrain and finish all
+     rounds, exiting 0.
+  4. BIT-EXACT: every completed round's set digest in the chaos
+     model_dir equals the control's (sha256 over dtype+shape+raw bytes
+     of every array — params AND optimizer state), and worker 1's
+     post-takeover round losses match the control's floats exactly.
+  5. LEDGER: ckpt_save events carry format="shard"/shards/set_digest,
+     ckpt_shard_write events carry per-shard bytes/latency, the
+     takeover's elastic_resume is format="shard", and the run report
+     renders the shard IO line.
+
+Exits nonzero on any failure.
+Run: JAX_PLATFORMS=cpu python tools/smoke_shardckpt.py
+(sibling of tools/smoke_elastic.py / smoke_fleet.py / chaos_train.py)
+"""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+WORKER = os.path.join(_REPO, "examples", "multi-machine",
+                      "elastic_worker.py")
+
+# kill windows sized by ROUND COUNT, not model size (this CPU trains
+# MLP rounds in ~100 ms); the stall throttles the leader's saves to
+# ~1.2 s/set so the parent's ledger poll (~0.1-0.3 s latency) lands
+# the SIGKILL inside a set write with seconds to spare
+NUM_ROUND = 40
+STALL_S = 0.6
+KILL_AFTER_ROUND = 3
+
+CONF_TMPL = """
+data = train
+iter = synthetic
+  num_inst = 4096
+  num_class = 16
+  input_shape = 1,1,32
+  seed_data = 3
+iter = end
+netconfig=start
+layer[+1:h1] = fullc:fc1
+  nhidden = 512
+  random_type = xavier
+layer[+1:a1] = relu
+layer[a1->out] = fullc:fc2
+  nhidden = 16
+  random_type = xavier
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,32
+batch_size = 64
+eta = 0.02
+momentum = 0.9
+metric = error
+num_round = %(num_round)d
+dev = cpu
+print_step = 0
+silent = 1
+save_period = 1
+save_async = 1
+shard_ckpt = 1
+shard_ckpt_shards = 2
+model_dir = %(model_dir)s
+telemetry_ledger = %(ledger)s
+"""
+
+ELASTIC_TMPL = """elastic_dir = %(elastic_dir)s
+elastic_heartbeat_s = 0.5
+elastic_grace_s = 15
+"""
+
+
+def write_conf(path, body):
+    with open(path, "w") as f:
+        f.write(body)
+    return path
+
+
+def read_ledger(path):
+    from cxxnet_tpu.telemetry.ledger import read_ledger as rl
+    try:
+        return rl(path)
+    except OSError:
+        return []
+
+
+def wait_for(pred, timeout_s, what, poll=0.1):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(poll)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def round_losses(events, host=None):
+    out = {}
+    for e in events:
+        if e.get("event") != "round_end":
+            continue
+        if host is not None and e.get("host") != host:
+            continue
+        out[int(e["round"])] = e.get("loss")
+    return out
+
+
+def set_digests(model_dir):
+    """{round: content digest} for every published shard set — the
+    full quorum+digest verification pass, per round."""
+    from cxxnet_tpu import checkpoint as ckpt
+    out = {}
+    for name in sorted(os.listdir(model_dir)):
+        m = re.match(r"^r(\d{4,})$", name)
+        if not m:
+            continue
+        path = os.path.join(model_dir, name)
+        if not os.path.exists(os.path.join(path, "MANIFEST.json")):
+            continue
+        out[int(m.group(1))] = ckpt.blob_digest(ckpt.verify_model(path))
+    return out
+
+
+def main() -> int:
+    td = tempfile.mkdtemp(prefix="smoke_shardckpt_")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               CXXNET_RUN_ID="smoke-shardckpt-0001")
+    env.pop("CXXNET_SHARD_WRITE_STALL_S", None)
+
+    # ---- 1. uninterrupted control --------------------------------------
+    ctl_ledger = os.path.join(td, "control.jsonl")
+    ctl_models = os.path.join(td, "control_models")
+    ctl_conf = write_conf(os.path.join(td, "control.conf"),
+                          CONF_TMPL % dict(num_round=NUM_ROUND,
+                                           model_dir=ctl_models,
+                                           ledger=ctl_ledger))
+    p = subprocess.run([sys.executable, "-m", "cxxnet_tpu.main", ctl_conf],
+                       cwd=_REPO, env=env, stdout=subprocess.PIPE,
+                       stderr=subprocess.STDOUT, timeout=600)
+    out = p.stdout.decode("utf-8", "replace")
+    assert p.returncode == 0, f"control exited {p.returncode}:\n{out[-4000:]}"
+    ctl_losses = round_losses(read_ledger(ctl_ledger))
+    ctl_digests = set_digests(ctl_models)
+    assert sorted(ctl_losses) == list(range(NUM_ROUND)), sorted(ctl_losses)
+    assert sorted(ctl_digests) == list(range(NUM_ROUND)), \
+        f"control shard sets incomplete: {sorted(ctl_digests)}"
+
+    # ---- 2. chaos fleet: stalled-writer leader + warm standby ----------
+    ledger = os.path.join(td, "run.jsonl")
+    models = os.path.join(td, "models")
+    conf = write_conf(
+        os.path.join(td, "chaos.conf"),
+        CONF_TMPL % dict(num_round=NUM_ROUND, model_dir=models,
+                         ledger=ledger)
+        + ELASTIC_TMPL % dict(elastic_dir=os.path.join(td, "elastic")))
+    w0_env = dict(env, CXXNET_SHARD_WRITE_STALL_S=str(STALL_S))
+    w0 = subprocess.Popen(
+        [sys.executable, WORKER, conf, "elastic_worker=0",
+         "telemetry_host=0"],
+        cwd=_REPO, env=w0_env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT)
+    wait_for(lambda: [e for e in read_ledger(ledger)
+                      if e.get("event") == "topology_change"
+                      and e.get("leader") == 0],
+             120, "worker 0 to form the first generation")
+    w1 = subprocess.Popen(
+        [sys.executable, WORKER, conf, "elastic_worker=1",
+         "telemetry_host=1"],
+        cwd=_REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT)
+
+    # ---- 3. SIGKILL mid-shard-save -------------------------------------
+    # a shard file of round >= KILL_AFTER_ROUND just landed; the writer
+    # is now stalling before its NEXT file — the manifest has not
+    # published. Kill inside that window.
+    ev = wait_for(
+        lambda: [e for e in read_ledger(ledger)
+                 if e.get("event") == "ckpt_shard_write"
+                 and e.get("host") == 0
+                 and e.get("round", -1) >= KILL_AFTER_ROUND
+                 and not os.path.exists(os.path.join(
+                     models, "r%04d" % e.get("round"), "MANIFEST.json"))],
+        180, "a mid-set shard write to kill inside")[-1]
+    torn_round = int(ev["round"])
+    w0.send_signal(signal.SIGKILL)
+    w0.communicate(timeout=30)
+    assert w0.returncode != 0, "SIGKILLed leader cannot exit 0"
+    torn_dir = os.path.join(models, "r%04d" % torn_round)
+    torn_shards = [f for f in os.listdir(torn_dir)
+                   if f.startswith("shard_")] if os.path.isdir(torn_dir) \
+        else []
+    assert torn_shards and not os.path.exists(
+        os.path.join(torn_dir, "MANIFEST.json")), \
+        f"kill missed the set-write window: {torn_dir} has " \
+        f"{os.listdir(torn_dir) if os.path.isdir(torn_dir) else 'nothing'}"
+
+    # ---- survivor quorum-rejects the torn set and falls back -----------
+    resume = wait_for(
+        lambda: [e for e in read_ledger(ledger)
+                 if e.get("event") == "elastic_resume"
+                 and e.get("host") == 1],
+        90, "survivor takeover resume")[0]
+    assert resume.get("format") == "shard", resume
+    k = int(resume["round"])
+    assert k < torn_round, \
+        f"takeover resumed round {k}, but round {torn_round} was torn " \
+        "mid-write and must have been quorum-rejected"
+
+    out1, _ = w1.communicate(timeout=600)
+    assert w1.returncode == 0, \
+        f"survivor exited {w1.returncode}:\n" \
+        f"{out1.decode('utf-8', 'replace')[-4000:]}"
+
+    events = read_ledger(ledger)
+    losses = round_losses(events)
+    assert sorted(losses) == list(range(NUM_ROUND)), \
+        f"chaos run did not cover all rounds: {sorted(losses)}"
+
+    # ---- 4. bit-exactness vs the uninterrupted control -----------------
+    # every published set in the chaos dir — the retrained torn round
+    # included — must carry the control's digest for that round
+    chaos_digests = set_digests(models)
+    assert torn_round in chaos_digests, \
+        "torn round was never republished by the survivor"
+    mismatched = {r: (chaos_digests[r], ctl_digests.get(r))
+                  for r in chaos_digests
+                  if chaos_digests[r] != ctl_digests.get(r)}
+    assert not mismatched, \
+        f"set digests diverge from control: {mismatched}"
+    # ... and the survivor's post-takeover losses are the control's
+    w1_rounds = {r: l for r, l in round_losses(events, host=1).items()
+                 if r > k}
+    assert w1_rounds, "survivor trained no post-takeover rounds"
+    for r, loss in sorted(w1_rounds.items()):
+        assert ctl_losses.get(r) == loss, \
+            f"round {r}: survivor loss {loss!r} != control " \
+            f"{ctl_losses.get(r)!r} — fallback resume is not bit-exact"
+
+    # ---- 5. ledger + report contract -----------------------------------
+    saves = [e for e in events if e.get("event") == "ckpt_save"
+             and e.get("ok")]
+    assert saves and all(e.get("format") == "shard" and
+                         e.get("shards") == 2 and e.get("set_digest")
+                         for e in saves), "ckpt_save shard fields missing"
+    shard_writes = [e for e in events
+                    if e.get("event") == "ckpt_shard_write"]
+    assert shard_writes and all(
+        e.get("bytes", 0) > 0 for e in shard_writes)
+    report_path = os.path.join(td, "REPORT.md")
+    rc = subprocess.call(
+        [sys.executable, os.path.join(_REPO, "tools", "report.py"),
+         "--ledger", ledger, "-o", report_path], cwd=_REPO)
+    assert rc == 0, "report.py failed"
+    md = open(report_path, encoding="utf-8").read()
+    assert "shard IO:" in md and "wrote shard sets" in md
+
+    print("smoke_shardckpt OK:", json.dumps({
+        "torn_round": torn_round,
+        "torn_shards_on_disk": sorted(torn_shards),
+        "takeover_resumed_round": k,
+        "rounds_bit_exact_vs_control": len(chaos_digests),
+        "survivor_rounds_checked": sorted(w1_rounds)[:5] + ["..."],
+        "shard_writes": len(shard_writes)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
