@@ -34,6 +34,7 @@ DURABLE_MODULES = (
     "cxxnet_tpu/telemetry/ledger.py",
     "cxxnet_tpu/telemetry/aggregate.py",   # fleet snapshot transport
     "cxxnet_tpu/elastic/",
+    "cxxnet_tpu/data_service/",            # reader status registry
 )
 
 #: modules whose append-mode opens implement the sanctioned O_APPEND

@@ -36,7 +36,8 @@ from .core import (Finding, LintPass, Project, build_parents,
 #: the validated config namespaces (doc/tasks.md; config.py owns the
 #: declarations, this is only the prefix filter)
 NAMESPACE_PREFIXES = ("serve_", "telemetry_", "elastic_", "io_retry_",
-                      "fsdp_", "shard_ckpt", "compile_cache")
+                      "fsdp_", "shard_ckpt", "compile_cache",
+                      "data_service")
 
 _FN = (ast.FunctionDef, ast.AsyncFunctionDef)
 

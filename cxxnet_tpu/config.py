@@ -502,6 +502,122 @@ def parse_elastic_config(cfg: ConfigPairs) -> ElasticConfig:
     return ec
 
 
+# -- input-data service -------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class DataServiceConfig:
+    """The ``data_service*`` knob set (doc/tasks.md "Input data
+    service"). One validated namespace, same contract as ``serve_*`` /
+    ``telemetry_*``: a typo'd key raises instead of silently training
+    off the local pipeline. ``data_service`` set = the train data
+    section is served by the reader fleet (or, with the special value
+    ``local``, by the same global-shuffle orchestration run
+    in-process — the deterministic control / degrade stream); unset =
+    everything below is inert."""
+    endpoints: str = ""           # data_service: host:port[,host:port]|local
+    shards: int = 0               # data_service_shards (0 = one/reader)
+    seed: int = 0                 # data_service_seed: global shuffle seed
+    cache_batches: int = 256      # data_service_cache: reader LRU frames
+    readahead: int = 2            # data_service_readahead: decode-ahead
+    timeout_ms: float = 5000.0    # data_service_timeout_ms: fetch timeout
+    local_fallback: int = 1       # data_service_local_fallback: 0 = hard
+    reader: int = -1              # data_service_reader: this reader's idx
+    status_dir: str = ""          # data_service_status_dir: atomic status
+    prefetch: int = 2             # data_service_prefetch: client batches
+    #                               fetched ahead on a thread (0 = off)
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.endpoints.strip())
+
+    @property
+    def local_only(self) -> bool:
+        return self.endpoints.strip().lower() == "local"
+
+    @property
+    def endpoint_list(self) -> List[str]:
+        if not self.enabled or self.local_only:
+            return []
+        return [e.strip() for e in self.endpoints.split(",") if e.strip()]
+
+    @property
+    def n_shards(self) -> int:
+        return self.shards or max(1, len(self.endpoint_list))
+
+    @staticmethod
+    def split_endpoint(endpoint: str) -> Tuple[str, int]:
+        host, _, port = endpoint.rpartition(":")
+        return host, int(port)
+
+
+def parse_data_service_config(cfg: ConfigPairs) -> DataServiceConfig:
+    """Collect/validate the ``data_service*`` keys (last occurrence
+    wins; unknown keys in the namespace fail fast)."""
+    known = {
+        "data_service": ("endpoints", str),
+        "data_service_shards": ("shards", int),
+        "data_service_seed": ("seed", int),
+        "data_service_cache": ("cache_batches", int),
+        "data_service_readahead": ("readahead", int),
+        "data_service_timeout_ms": ("timeout_ms", float),
+        "data_service_local_fallback": ("local_fallback", int),
+        "data_service_reader": ("reader", int),
+        "data_service_status_dir": ("status_dir", str),
+        "data_service_prefetch": ("prefetch", int),
+    }
+    vals = {}
+    for name, val in cfg:
+        if name.startswith("data_service"):
+            if name not in known:
+                raise ConfigError(
+                    f"unknown data_service setting {name!r}; valid "
+                    "keys: " + ", ".join(sorted(known)))
+            field, conv = known[name]
+            try:
+                vals[field] = conv(val)
+            except ValueError as e:
+                raise ConfigError(f"bad {name} value {val!r}: {e}")
+    dc = DataServiceConfig(**vals)
+    if dc.enabled and not dc.local_only:
+        for ep in dc.endpoint_list:
+            host, _, port = ep.rpartition(":")
+            if not host or not port.isdigit():
+                raise ConfigError(
+                    f"data_service endpoint {ep!r} is not host:port "
+                    "(or the single value 'local')")
+    if dc.shards < 0:
+        raise ConfigError(
+            f"data_service_shards must be >= 0 (0 = one per reader), "
+            f"got {dc.shards}")
+    if dc.cache_batches < 1:
+        raise ConfigError(
+            f"data_service_cache must be >= 1, got {dc.cache_batches}")
+    if dc.readahead < 0:
+        raise ConfigError(
+            f"data_service_readahead must be >= 0, got {dc.readahead}")
+    if dc.prefetch < 0:
+        raise ConfigError(
+            f"data_service_prefetch must be >= 0, got {dc.prefetch}")
+    if dc.timeout_ms <= 0:
+        raise ConfigError(
+            f"data_service_timeout_ms must be > 0, got "
+            f"{dc.timeout_ms}")
+    if dc.local_fallback not in (0, 1):
+        raise ConfigError(
+            f"data_service_local_fallback must be 0 or 1, got "
+            f"{dc.local_fallback}")
+    if dc.reader < -1:
+        raise ConfigError(
+            f"data_service_reader must be >= 0 (or -1 = unset), got "
+            f"{dc.reader}")
+    if dc.enabled and dc.local_only and dc.shards < 1:
+        raise ConfigError(
+            "data_service = local needs an explicit "
+            "data_service_shards >= 1 (there is no endpoint list to "
+            "default the shard count from)")
+    return dc
+
+
 # -- IO retry policy ----------------------------------------------------------
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,30 @@
+"""Disaggregated input-data service (doc/tasks.md "Input data service").
+
+Reader processes own packed-record shards and serve decoded,
+augmented, batched tensors to trainers over the wire, so decode cost
+is paid once per fleet and trainers stay compute-bound:
+
+* :mod:`assign` — fleet-deterministic shard assignment, movement-
+  minimal rebalance, seeded epoch permutation (global shuffle);
+* :mod:`pipeline` — (epoch, shard, batch) addressing over the
+  existing decode/augment/batch pipeline;
+* :mod:`wire` — length-prefixed batch frames over TCP;
+* :mod:`reader` — the ``task = data_reader`` server with its bounded
+  prefetch cache;
+* :mod:`client` — the trainer-side iterator with retry, failover,
+  client-side rebalance, and local degrade.
+"""
+
+from .assign import (assign_shards, epoch_permutation, moved_shards,
+                     owner_map, rebalance, stream_seed)
+from .client import (DataServiceClient, NoReaderAvailable,
+                     ServiceIterator, build_service_iterator)
+from .pipeline import LocalShardSource, shard_section
+from .reader import DataReaderServer
+
+__all__ = [
+    "assign_shards", "epoch_permutation", "moved_shards", "owner_map",
+    "rebalance", "stream_seed", "DataServiceClient",
+    "NoReaderAvailable", "ServiceIterator", "build_service_iterator",
+    "LocalShardSource", "shard_section", "DataReaderServer",
+]

@@ -1,0 +1,142 @@
+"""Deterministic (epoch, shard, batch) addressing over local pipelines.
+
+The service's unit of work is a *batch address* ``(epoch, shard,
+batch_idx)``. This module maps an address to concrete decoded tensors
+by building the EXISTING decode/augment/batch pipeline
+(``io.data.create_iterator`` over the config's data section — imgrec
+decode pool, augmentation, threadbuffer, all of it) per ``(epoch,
+shard)`` with:
+
+* ``dist_num_worker = n_shards`` / ``dist_worker_rank = shard`` — the
+  shard IS the pipeline's worker-shard (byte-range recordio shards,
+  round-robin binpage pages, whole-file conf packs: whatever the
+  iterator already supports);
+* ``seed_data = stream_seed(seed, epoch, shard)`` — a fresh
+  deterministic seed per epoch and shard, so within-shard shuffle
+  never repeats across epochs yet every process derives the identical
+  stream.
+
+Because the mapping is a pure function of ``(section config, service
+seed, address)``, ANY holder of the config can serve ANY address:
+readers serve their assigned shards (and, on failover, anyone's), the
+client's degrade path replays the same stream locally, and a
+rebalanced successor continues a departed reader's shard bit-exactly
+from the client's own position counters — no iterator state crosses
+the wire, ever.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+from ..config import ConfigPairs
+from ..io.data import DataBatch, DataIter, create_iterator
+from .assign import stream_seed
+
+#: config keys owned by the service namespace, stripped before the
+#: section reaches the ordinary iterator chain
+_SERVICE_PREFIX = "data_service"
+
+
+def shard_section(pairs: ConfigPairs, n_shards: int, shard: int,
+                  seed: int, epoch: int) -> ConfigPairs:
+    """The config section for one (epoch, shard) pipeline: service
+    keys stripped, shard identity + epoch seed appended LAST so they
+    override whatever the section set (last occurrence wins at
+    set_param time)."""
+    if not 0 <= shard < n_shards:
+        raise ValueError(f"shard {shard} outside [0, {n_shards})")
+    base = [(k, v) for k, v in pairs if not k.startswith(_SERVICE_PREFIX)]
+    base += [("dist_num_worker", str(int(n_shards))),
+             ("dist_worker_rank", str(int(shard))),
+             ("seed_data", str(stream_seed(seed, epoch, shard)))]
+    return base
+
+
+def close_chain(it) -> None:
+    """Release a pipeline chain's background resources: threadbuffer
+    producers (``close()``) and decode thread pools (``_pool``). A
+    cursor abandoned by an epoch rebuild must not leak a spinning
+    producer or an 8-thread executor per (epoch, shard)."""
+    seen = set()
+    while it is not None and id(it) not in seen:
+        seen.add(id(it))
+        close = getattr(it, "close", None)
+        if callable(close):
+            try:
+                close()
+            except Exception:
+                pass
+        pool = getattr(it, "_pool", None)
+        if pool is not None and hasattr(pool, "shutdown"):
+            pool.shutdown(wait=False)
+        it = getattr(it, "base", None)
+
+
+@dataclasses.dataclass
+class _Cursor:
+    epoch: int
+    it: DataIter
+    next_b: int = 0
+
+
+class LocalShardSource:
+    """Sequential batch server over per-shard pipelines with one
+    cursor per shard. ``get`` returns the addressed batch or None past
+    the shard's end-of-epoch; backward seeks (a rebalanced-in shard, a
+    cache-evicted replay) rebuild the deterministic pipeline and fast-
+    forward. Callers serialize access PER SHARD (each shard's cursor
+    is independent state): the reader holds one decode lock per
+    shard, the client owns it from a single thread."""
+
+    def __init__(self, pairs: ConfigPairs, n_shards: int, seed: int):
+        self.pairs = list(pairs)
+        self.n_shards = int(n_shards)
+        self.seed = int(seed)
+        self._cursors: Dict[int, _Cursor] = {}
+        # known end-of-epoch lengths: (epoch, shard) -> batch count
+        self._lens: Dict[Tuple[int, int], int] = {}
+
+    def _open(self, epoch: int, shard: int) -> _Cursor:
+        old = self._cursors.get(shard)
+        if old is not None:
+            close_chain(old.it)
+        it = create_iterator(shard_section(
+            self.pairs, self.n_shards, shard, self.seed, epoch))
+        it.before_first()
+        cur = _Cursor(epoch=epoch, it=it)
+        self._cursors[shard] = cur
+        return cur
+
+    def close(self) -> None:
+        """Release every open cursor's chain (reader shutdown, client
+        degrade-source teardown)."""
+        for cur in self._cursors.values():
+            close_chain(cur.it)
+        self._cursors.clear()
+
+    def length(self, epoch: int, shard: int) -> Optional[int]:
+        """Batch count of an exhausted (epoch, shard) stream, if
+        known."""
+        return self._lens.get((epoch, shard))
+
+    def get(self, epoch: int, shard: int, batch: int
+            ) -> Optional[DataBatch]:
+        known = self._lens.get((epoch, shard))
+        if known is not None and batch >= known:
+            return None
+        cur = self._cursors.get(shard)
+        if cur is None or cur.epoch != epoch or cur.next_b > batch:
+            cur = self._open(epoch, shard)
+        while True:
+            b = cur.it.next()
+            if b is None:
+                self._lens[(epoch, shard)] = cur.next_b
+                return None
+            cur.next_b += 1
+            if cur.next_b - 1 == batch:
+                return b
+            # fast-forwarding a backward/ahead seek: decoded batches
+            # before the requested index are discarded (the caller's
+            # cache exists to make this rare)

@@ -1,0 +1,196 @@
+"""Wire format of the input-data service: length-prefixed batch frames.
+
+One reader connection carries many requests. A request is a single
+newline-terminated JSON line (``{"op": "fetch", "epoch": e, "shard": s,
+"batch": b}``; also ``stats`` and ``meta``); the response is one frame::
+
+    uint32 magic 0xDA7AFEED | uint32 header_len | header_json | payloads
+
+The header's ``arrays`` list describes every payload in order
+(``{"name", "dtype", "shape"}``); payloads are raw C-order bytes
+concatenated directly after the header — a decoded uint8 image batch
+crosses the wire at 1 byte/px, the same 4x-smaller-than-fp32 transfer
+the ``device_normalize`` H2D path exploits. ``status`` is ``ok`` (a
+batch follows), ``eos`` (the addressed shard has fewer batches this
+epoch), or ``error`` (the ``error`` field explains; the client treats
+it like a dead connection and fails over).
+
+Everything here is stdlib + numpy: the transport must work in a reader
+process that never imports jax.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..io.data import DataBatch
+
+MAGIC = 0xDA7AFEED
+_HDR = struct.Struct("<II")
+
+#: sanity bound on one frame (header + payloads): a corrupt/foreign
+#: peer must not make the client allocate gigabytes from 4 wild bytes
+MAX_FRAME_BYTES = 1 << 30
+
+#: request lines are tiny JSON objects; anything longer is a protocol
+#: violation, not a big request
+MAX_REQUEST_BYTES = 1 << 16
+
+
+class WireError(OSError):
+    """Malformed frame / protocol violation (treated as a failed
+    endpoint by the client's failover logic — it subclasses OSError
+    so one retry policy covers sockets and framing)."""
+
+
+def pack_frame(header: Dict[str, Any],
+               arrays: List[Tuple[str, np.ndarray]] = ()) -> bytes:
+    """Serialize a response frame; ``arrays`` entries are appended to
+    (a copy of) the header's ``arrays`` descriptor list in order."""
+    hdr = dict(header)
+    descs = []
+    payloads = []
+    for name, arr in arrays:
+        a = np.ascontiguousarray(arr)
+        descs.append({"name": name, "dtype": a.dtype.str,
+                      "shape": list(a.shape)})
+        payloads.append(a.tobytes())
+    hdr["arrays"] = descs
+    hj = json.dumps(hdr, sort_keys=True).encode("utf-8")
+    return b"".join([_HDR.pack(MAGIC, len(hj)), hj] + payloads)
+
+
+def pack_batch(db: DataBatch, **meta: Any) -> bytes:
+    """One decoded/augmented/batched tensor set as an ``ok`` frame
+    (``meta`` lands in the header — e.g. the ``batch`` address field).
+    The deferred-normalization dict (uint8 ``device_normalize``
+    pipelines) rides along: scalars in the header, a mean image as a
+    payload array."""
+    header: Dict[str, Any] = {"status": "ok",
+                              "num_batch_padd": int(db.num_batch_padd)}
+    header.update(meta)
+    arrays: List[Tuple[str, np.ndarray]] = [
+        ("data", db.data), ("label", db.label)]
+    if db.inst_index is not None:
+        arrays.append(("inst_index", np.asarray(db.inst_index)))
+    for i, extra in enumerate(db.extra_data):
+        arrays.append((f"extra_{i}", np.asarray(extra)))
+    if db.norm is not None:
+        norm = dict(db.norm)
+        mean = norm.get("mean")
+        if mean is not None:
+            arrays.append(("norm_mean", np.asarray(mean)))
+            norm["mean"] = "__payload__"
+        header["norm"] = norm
+    return pack_frame(header, arrays)
+
+
+def pack_eos(**meta: Any) -> bytes:
+    return pack_frame(dict(meta, status="eos"))
+
+
+def pack_error(message: str, **meta: Any) -> bytes:
+    return pack_frame(dict(meta, status="error", error=str(message)))
+
+
+def batch_from(header: Dict[str, Any],
+               arrays: Dict[str, np.ndarray]) -> DataBatch:
+    """Rebuild the DataBatch a frame carries (``status`` must be
+    ``ok``). Any malformation raises :class:`WireError` so the
+    client's failover ladder absorbs it like a dead endpoint."""
+    if "data" not in arrays or "label" not in arrays:
+        raise WireError("frame lacks data/label payloads")
+    try:
+        extra = []
+        i = 0
+        while f"extra_{i}" in arrays:
+            extra.append(arrays[f"extra_{i}"])
+            i += 1
+        norm = header.get("norm")
+        if norm is not None:
+            norm = dict(norm)
+            if norm.get("mean") == "__payload__":
+                if "norm_mean" not in arrays:
+                    raise WireError("frame norm references a missing "
+                                    "norm_mean payload")
+                norm["mean"] = arrays["norm_mean"]
+        return DataBatch(
+            data=arrays["data"], label=arrays["label"],
+            num_batch_padd=int(header.get("num_batch_padd", 0)),
+            inst_index=arrays.get("inst_index"),
+            extra_data=extra, norm=norm)
+    except (KeyError, TypeError, ValueError) as e:
+        raise WireError(f"malformed batch frame: {e}")
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    """Read exactly ``n`` bytes or raise (a short read mid-frame is a
+    torn response, never a valid end)."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        k = sock.recv_into(view[got:], n - got)
+        if k == 0:
+            raise WireError(
+                f"connection closed mid-frame ({got}/{n} bytes)")
+        got += k
+    return bytes(buf)
+
+
+def recv_frame(sock: socket.socket
+               ) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
+    """Read one response frame -> (header, {name: array})."""
+    magic, hlen = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    if magic != MAGIC:
+        raise WireError(f"bad frame magic 0x{magic:08x}")
+    if hlen > MAX_FRAME_BYTES:
+        raise WireError(f"frame header length {hlen} exceeds bound")
+    try:
+        header = json.loads(_recv_exact(sock, hlen).decode("utf-8"))
+    except ValueError as e:
+        raise WireError(f"unparseable frame header: {e}")
+    arrays: Dict[str, np.ndarray] = {}
+    total = 0
+    for desc in header.get("arrays", ()):
+        try:
+            dtype = np.dtype(desc["dtype"])
+            shape = tuple(int(d) for d in desc["shape"])
+            name = desc["name"]
+        except (KeyError, TypeError, ValueError) as e:
+            raise WireError(f"malformed array descriptor {desc!r}: {e}")
+        if any(d < 0 for d in shape):
+            raise WireError(f"negative dimension in {desc!r}")
+        nbytes = int(dtype.itemsize * int(np.prod(shape, dtype=np.int64)))
+        total += nbytes
+        if total > MAX_FRAME_BYTES:
+            raise WireError("frame payloads exceed size bound")
+        raw = _recv_exact(sock, nbytes)
+        arrays[name] = np.frombuffer(raw, dtype).reshape(shape)
+    return header, arrays
+
+
+def send_request(sock: socket.socket, req: Dict[str, Any]) -> None:
+    sock.sendall(json.dumps(req).encode("utf-8") + b"\n")
+
+
+def read_request(rfile) -> Optional[Dict[str, Any]]:
+    """Read one request line from a file-like socket reader; None on a
+    cleanly closed connection."""
+    line = rfile.readline(MAX_REQUEST_BYTES + 1)
+    if not line:
+        return None
+    if len(line) > MAX_REQUEST_BYTES:
+        raise WireError("oversized request line")
+    try:
+        req = json.loads(line.decode("utf-8"))
+    except ValueError as e:
+        raise WireError(f"unparseable request line: {e}")
+    if not isinstance(req, dict):
+        raise WireError("request is not a JSON object")
+    return req

@@ -33,6 +33,17 @@ from . import iter_mnist  # noqa: F401  (register mnist)
 _TB_SEQ = itertools.count()
 
 
+class _ProducerError:
+    """Queue sentinel carrying an exception out of the producer
+    thread: a fetch that dies must surface on the CONSUMER side, not
+    silently end the producer — a consumer blocked on an unbounded
+    ``queue.get`` behind a dead producer is an indefinite hang."""
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
 @register_iter("threadbuffer")
 class ThreadBufferIterator(DataIter):
     """Background-thread prefetch with a bounded queue. The reference uses a
@@ -82,31 +93,39 @@ class ThreadBufferIterator(DataIter):
     def init(self):
         pass
 
-    def _producer(self):
-        self.base.before_first()
+    def _put(self, item) -> bool:
+        """TIMED put re-checking _stop: a plain blocking put deadlocks
+        teardown when the queue is full and the consumer has stopped
+        draining (the drain-and-join below would wait forever on a
+        producer stuck in put) — the PR-1..3 shutdown hang. Returns
+        False when teardown interrupted the put."""
         while not self._stop.is_set():
-            t0 = time.perf_counter()
-            batch = self.base.next()
-            self._h_fetch.observe(time.perf_counter() - t0)
-            # TIMED put re-checking _stop: a plain blocking put deadlocks
-            # teardown when the queue is full and the consumer has
-            # stopped draining (before_first's join would wait forever
-            # on a producer stuck in put) — the PR-1..3 shutdown hang
-            while not self._stop.is_set():
-                try:
-                    self._queue.put(batch, timeout=0.05)
-                    break
-                except queue.Full:
-                    continue
-            if batch is None:
-                return
+            try:
+                self._queue.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
 
-    def before_first(self):
-        # tear down any in-flight producer, then restart: signal stop,
-        # then DRAIN-AND-JOIN in a loop — one drain pass is not enough,
-        # because the producer may refill the freed slot before it
-        # observes _stop (the timed put above bounds how long that goes
-        # on; without it this join could hang)
+    def _producer(self):
+        try:
+            self.base.before_first()
+            while not self._stop.is_set():
+                t0 = time.perf_counter()
+                batch = self.base.next()
+                self._h_fetch.observe(time.perf_counter() - t0)
+                if not self._put(batch) or batch is None:
+                    return
+        except BaseException as e:      # noqa: BLE001 — relayed, not eaten
+            # the consumer re-raises this from next(); a producer that
+            # died silently would leave next() blocked forever
+            self._put(_ProducerError(e))
+
+    def _join_producer(self):
+        """Signal stop, then DRAIN-AND-JOIN in a loop — one drain pass
+        is not enough, because the producer may refill the freed slot
+        before it observes _stop (the timed put bounds how long that
+        goes on; without it this join could hang)."""
         if self._thread is not None and self._thread.is_alive():
             self._stop.set()
             while self._thread.is_alive():
@@ -116,6 +135,9 @@ class ThreadBufferIterator(DataIter):
                 except queue.Empty:
                     pass
                 self._thread.join(timeout=0.05)
+
+    def before_first(self):
+        self._join_producer()
         self._stop.clear()
         self._queue = queue.Queue(maxsize=self.buffer_size)
         self._thread = threading.Thread(target=self._producer, daemon=True,
@@ -125,7 +147,45 @@ class ThreadBufferIterator(DataIter):
     def next(self):
         if self._queue is None:
             self.before_first()
-        return self._queue.get()
+        item = self._queue.get()
+        if isinstance(item, _ProducerError):
+            raise item.exc
+        return item
+
+    def close(self):
+        """Orderly teardown: stop + join the producer (releasing its
+        buffered batches), then close the wrapped base when it can be
+        closed — abandoned chains must not leak a spinning producer."""
+        self._join_producer()
+        base_close = getattr(self.base, "close", None)
+        if callable(base_close):
+            base_close()
+
+
+@register_iter("throttle")
+class ThrottleIterator(DataIter):
+    """Delay every ``next()`` by ``throttle_ms`` — a deterministic
+    stand-in for an expensive decode, used by the data-starvation
+    drills (doc/tasks.md "Input data service"): a trainer fed through
+    a throttled local pipeline goes input-bound, the same trainer fed
+    the same batches by a warmed data-service reader does not."""
+
+    def set_param(self, name, val):
+        if name == "throttle_ms":
+            self.throttle_ms = float(val)
+
+    def __init__(self, cfg, base: DataIter):
+        self.throttle_ms = 0.0
+        self.base = base
+        super().__init__(cfg)
+
+    def before_first(self):
+        self.base.before_first()
+
+    def next(self):
+        if self.throttle_ms > 0:
+            time.sleep(self.throttle_ms / 1e3)
+        return self.base.next()
 
 
 @register_iter("membuffer")

@@ -20,8 +20,9 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from .config import (ConfigPairs, parse_cli_overrides, parse_ckpt_config,
-                     parse_config_file, parse_elastic_config,
-                     parse_retry_policy, parse_telemetry_config)
+                     parse_config_file, parse_data_service_config,
+                     parse_elastic_config, parse_retry_policy,
+                     parse_telemetry_config)
 from .graph import global_param
 from .io.data import DataBatch, create_iterator
 from .resilience import SentinelAbort, TrainingSentinel, counters, failpoints
@@ -131,6 +132,11 @@ class LearnTask:
         # Trainer's knob; compile_cache_dir is enabled below once the
         # telemetry session exists (its ledger event must land)
         self.ckpt_cfg = parse_ckpt_config(self.global_cfg)
+        # -- input-data service (doc/tasks.md "Input data service") -------
+        # data_service = host:port[,host:port] routes the train data
+        # section through the reader fleet (decode paid once per
+        # fleet); task=data_reader makes THIS process a reader
+        self.data_service = parse_data_service_config(self.global_cfg)
         # -- telemetry (doc/tasks.md "Telemetry") -------------------------
         # telemetry_trace / telemetry_port / telemetry_log /
         # telemetry_profile_steps / telemetry_sync_interval — one
@@ -281,6 +287,18 @@ class LearnTask:
     def train_iter(self):
         for kind, name, pairs in self.sections:
             if kind == "data":
+                if self.data_service.enabled \
+                        and self.task in ("train", "finetune"):
+                    # TRAINING only: eval sections stay local, and the
+                    # pred/extract tasks (which fall back to the data
+                    # section when no pred section exists) keep the
+                    # section's sequential order — output files are a
+                    # row-order contract the service's global-shuffle
+                    # stream would scramble
+                    from .data_service.client import build_service_iterator
+                    return build_service_iterator(
+                        self.global_cfg + pairs, self.data_service,
+                        silent=bool(self.silent))
                 return self._make_iter(pairs)
         return None
 
@@ -365,6 +383,8 @@ class LearnTask:
                 self.task_get_weight()
             elif self.task == "serve":
                 self.task_serve()
+            elif self.task == "data_reader":
+                self.task_data_reader()
             else:
                 raise ValueError(f"unknown task {self.task!r}")
         except BaseException as e:
@@ -393,6 +413,9 @@ class LearnTask:
         try:
             self._train_rounds(tr, itr_train, evals)
         finally:
+            # a data-service iterator owns sockets + a prefetch thread
+            if hasattr(itr_train, "close"):
+                itr_train.close()
             # finalize the trace even when the loop dies mid-round — the
             # crashing/interrupted run is the one whose profile matters
             if self.profile_dir:
@@ -605,6 +628,11 @@ class LearnTask:
                 finally:
                     self._elastic_cb = None
                     self._elastic_step_cb = None
+                    # every stint builds a fresh train iterator; a
+                    # dropped data-service one would keep fetching the
+                    # in-flight epoch (sockets + prefetch thread)
+                    if hasattr(itr_train, "close"):
+                        itr_train.close()
                 self._elastic_finish(tr, coord)
                 return
         except Preempted:
@@ -835,6 +863,12 @@ class LearnTask:
         end_round = self.num_round
         if self.max_round > 0:
             end_round = min(end_round, self.start_counter + self.max_round)
+        if hasattr(itr_train, "set_epoch"):
+            # data-service epochs are addressed, not counted: align the
+            # iterator with the resume round so continue=1 / elastic
+            # takeovers replay exactly the epoch the uninterrupted run
+            # would have served (elastic/resume.py carries the round)
+            itr_train.set_epoch(self.start_counter)
         self._end_round = end_round
         self._sentinel_tick = 0
         self._profile_summarized = False
@@ -1102,6 +1136,30 @@ class LearnTask:
                 slo_window_s=sc.slo_window_s,
                 slo_burn_degraded=sc.slo_burn_degraded,
                 silent=bool(self.silent))
+        srv.start()
+        srv.serve_until_interrupt()
+
+    def task_data_reader(self) -> None:
+        """Reader process of the disaggregated input-data service
+        (doc/tasks.md "Input data service"): own this rank's shard
+        subset of the train data section and serve decoded/augmented/
+        batched frames to trainer clients until SIGTERM/SIGINT. The
+        trainer side is ``data_service = host:port[,...]`` on an
+        ordinary ``task = train`` run."""
+        from .data_service.reader import DataReaderServer
+        pairs = next((p for kind, _name, p in self.sections
+                      if kind == "data"), None)
+        if pairs is None:
+            raise ValueError(
+                "task=data_reader needs a data = train section (the "
+                "pipeline it serves)")
+        if not self.data_service.enabled or self.data_service.local_only:
+            raise ValueError(
+                "task=data_reader requires data_service = "
+                "host:port[,host:port] naming the reader fleet")
+        srv = DataReaderServer(self.global_cfg + pairs,
+                               self.data_service,
+                               silent=bool(self.silent))
         srv.start()
         srv.serve_until_interrupt()
 

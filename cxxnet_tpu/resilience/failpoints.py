@@ -42,6 +42,12 @@ Sites installed in this codebase:
                       the sentinel must catch and roll back)
 ``serve.infer``       serve.engine.InferenceEngine.run_padded (a failing
                       device dispatch — what trips the serve breaker)
+``data.fetch``        data_service.client, inside each per-endpoint
+                      fetch attempt — exercises the retry/backoff AND
+                      failover ladder of the input-data service client
+``data.serve``        data_service.reader, per request — the reader
+                      answers an error frame, which the client treats
+                      like a dead endpoint (failover, then degrade)
 ====================  =====================================================
 """
 

@@ -67,6 +67,9 @@ KNOWN_EVENTS = (
     # elastic training (elastic/coordinator.py, resume.py, preempt.py)
     "elastic_join", "elastic_leave", "topology_change",
     "elastic_resume", "elastic_advice",
+    # input-data service (data_service/reader.py, client.py)
+    "dataservice_start", "dataservice_stop", "dataservice_rebalance",
+    "dataservice_degrade",
 )
 
 

@@ -80,11 +80,11 @@ QUICK_MODULES = {
     "test_binpage.py",
     "test_capi.py",
     "test_config.py",
-    # test_elastic.py and test_shard_ckpt.py are NOT module-listed:
-    # their fast protocol/format tests carry explicit
-    # @pytest.mark.quick marks, while the multi-run LearnTask /
-    # subprocess (compile-cache warm restart) tests stay out of the
-    # tier
+    # test_elastic.py, test_shard_ckpt.py and test_dataservice.py are
+    # NOT module-listed: their fast protocol/format tests carry
+    # explicit @pytest.mark.quick marks, while the multi-run LearnTask
+    # / subprocess (compile-cache warm restart, steptime-verdict
+    # train) tests stay out of the tier
     "test_fused_stem_pool.py",
     "test_graph.py",
     "test_import_cxxnet.py",

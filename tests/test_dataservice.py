@@ -1,0 +1,658 @@
+"""Input-data service tests (doc/tasks.md "Input data service").
+
+Covers the ROADMAP-5 contracts: fleet-deterministic assignment (every
+rank derives the identical map), movement-minimal rebalance, seeded
+epoch permutation (global shuffle, no shard-local ordering bias), the
+wire protocol, reader cache behavior, the client's retry / failover /
+degrade ladder (driven through the ``data.fetch`` / ``data.serve``
+failpoints), bit-exact iterator position across a 2->1 reader
+rebalance, and the step-time probe's input-bound -> compute-bound
+verdict flip when the service feeds a decode-throttled trainer.
+"""
+
+import hashlib
+import os
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.config import (ConfigError, parse_config_string,
+                               parse_data_service_config)
+from cxxnet_tpu.data_service import assign, wire
+from cxxnet_tpu.data_service.client import (DataServiceClient,
+                                            NoReaderAvailable,
+                                            build_service_iterator)
+from cxxnet_tpu.data_service.pipeline import LocalShardSource
+from cxxnet_tpu.data_service.reader import DataReaderServer
+from cxxnet_tpu.io.data import DataBatch
+from cxxnet_tpu.resilience import failpoints
+
+SECTION = parse_config_string("""
+iter = synthetic
+num_inst = 96
+batch_size = 16
+num_class = 5
+input_shape = 1,1,8
+io_retry_attempts = 2
+io_retry_base_ms = 5
+io_retry_max_ms = 20
+""")
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.clear()
+    yield
+    failpoints.clear()
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _svc(endpoints, shards=3, **kv):
+    # prefetch off by default: unit tests reach into the raw
+    # ServiceIterator (client/degraded); the wrapper has its own test
+    kv.setdefault("data_service_prefetch", 0)
+    pairs = [("data_service", endpoints),
+             ("data_service_shards", str(shards))]
+    pairs += [(k, str(v)) for k, v in kv.items()]
+    return parse_data_service_config(pairs)
+
+
+def _start_fleet(n_readers, shards=3, pairs=SECTION, **kv):
+    ports = [_free_port() for _ in range(n_readers)]
+    endpoints = ",".join(f"127.0.0.1:{p}" for p in ports)
+    readers = []
+    for i in range(n_readers):
+        srv = DataReaderServer(
+            pairs, _svc(endpoints, shards=shards,
+                        data_service_reader=i, **kv),
+            silent=True)
+        srv.start()
+        readers.append(srv)
+    return endpoints, readers
+
+
+def _digest_stream(it, epoch=None):
+    if epoch is not None:
+        it.set_epoch(epoch)
+    it.before_first()
+    out = []
+    while True:
+        b = it.next()
+        if b is None:
+            return out
+        out.append(hashlib.sha256(
+            np.ascontiguousarray(b.data).tobytes()
+            + np.ascontiguousarray(b.label).tobytes()).hexdigest())
+
+
+# -- assignment ---------------------------------------------------------------
+
+@pytest.mark.quick
+def test_assignment_identical_on_every_rank():
+    """The map is a pure function of (sizes, reader list): any process
+    holding the config derives the identical assignment."""
+    sizes = [5, 3, 8, 1, 1, 9, 2, 2]
+    readers = ["h0:1", "h1:1", "h2:1"]
+    maps = [assign.assign_shards(sizes, readers) for _ in range(4)]
+    assert all(m == maps[0] for m in maps)
+    # every shard placed exactly once
+    owners = assign.owner_map(maps[0])
+    assert sorted(owners) == list(range(len(sizes)))
+
+
+@pytest.mark.quick
+def test_assignment_greedy_balance():
+    sizes = [10, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1]   # one giant + ten small
+    m = assign.assign_shards(sizes, ["a:1", "b:1"])
+    loads = {r: sum(sizes[s] for s in shards) for r, shards in m.items()}
+    assert max(loads.values()) == 10 and min(loads.values()) == 10
+
+
+@pytest.mark.quick
+def test_rebalance_leave_moves_only_orphans():
+    sizes = [1] * 8
+    m = assign.assign_shards(sizes, ["a:1", "b:1"])
+    orphans = set(m["b:1"])
+    m2 = assign.rebalance(m, sizes, ["a:1"])
+    assert sorted(m2["a:1"]) == list(range(8))
+    assert assign.moved_shards(m, m2) == orphans
+
+
+@pytest.mark.quick
+def test_rebalance_join_moves_minimal_set():
+    sizes = [1] * 8
+    m = assign.assign_shards(sizes, ["a:1", "b:1"])
+    m2 = assign.rebalance(m, sizes, ["a:1", "b:1", "c:1"])
+    moved = assign.moved_shards(m, m2)
+    # survivors keep a subset of what they had; only the level-up set
+    # (8 shards over 3 readers -> the new reader needs 2) moves
+    assert set(m2["a:1"]) <= set(m["a:1"])
+    assert set(m2["b:1"]) <= set(m["b:1"])
+    assert moved == set(m2["c:1"]) and len(moved) == 2
+    loads = sorted(len(v) for v in m2.values())
+    assert loads == [2, 3, 3]
+
+
+@pytest.mark.quick
+def test_epoch_permutation_shuffles_globally():
+    p0 = assign.epoch_permutation(7, 0, 16)
+    p1 = assign.epoch_permutation(7, 1, 16)
+    assert sorted(p0) == list(range(16)) and sorted(p1) == list(range(16))
+    assert p0 != p1                      # no epoch repeats another's order
+    assert assign.epoch_permutation(7, 0, 16) == p0       # deterministic
+    assert assign.epoch_permutation(8, 0, 16) != p0       # seed matters
+
+
+@pytest.mark.quick
+def test_stream_seed_deterministic_and_uncorrelated():
+    seen = {assign.stream_seed(3, e, s) for e in range(4) for s in range(4)}
+    assert len(seen) == 16
+    assert assign.stream_seed(3, 1, 2) == assign.stream_seed(3, 1, 2)
+
+
+# -- wire protocol ------------------------------------------------------------
+
+@pytest.mark.quick
+def test_wire_batch_roundtrip():
+    batch = DataBatch(
+        data=np.arange(2 * 4 * 4 * 3, dtype=np.uint8).reshape(2, 4, 4, 3),
+        label=np.asarray([[1.0], [2.0]], np.float32),
+        num_batch_padd=1,
+        inst_index=np.asarray([7, 8], np.int64),
+        extra_data=[np.ones((2, 2), np.float32)],
+        norm={"mean": np.full((4, 4, 3), 0.5, np.float32),
+              "divideby": 255.0, "scale": 1.0})
+    frame = wire.pack_batch(batch, epoch=1, shard=2, batch=3)
+    a, b = socket.socketpair()
+    try:
+        a.sendall(frame)
+        header, arrays = wire.recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+    assert (header["status"], header["epoch"], header["shard"],
+            header["batch"]) == ("ok", 1, 2, 3)
+    out = wire.batch_from(header, arrays)
+    np.testing.assert_array_equal(out.data, batch.data)
+    np.testing.assert_array_equal(out.label, batch.label)
+    np.testing.assert_array_equal(out.inst_index, batch.inst_index)
+    np.testing.assert_array_equal(out.extra_data[0], batch.extra_data[0])
+    np.testing.assert_array_equal(out.norm["mean"], batch.norm["mean"])
+    assert out.norm["divideby"] == 255.0
+    assert out.num_batch_padd == 1
+
+
+@pytest.mark.quick
+def test_wire_rejects_bad_magic():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(b"\x00" * 16)
+        with pytest.raises(wire.WireError):
+            wire.recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+# -- reader + client ----------------------------------------------------------
+
+@pytest.mark.quick
+def test_service_stream_matches_local_control_and_caches():
+    """One reader serves the SAME stream the in-process control
+    computes (digest-equal for a fixed seed), and a second pass over
+    the same addresses is answered from the prefetch cache."""
+    endpoints, readers = _start_fleet(1)
+    try:
+        it = build_service_iterator(SECTION, _svc(endpoints))
+        d1 = _digest_stream(it)
+        control = build_service_iterator(
+            SECTION, _svc("local", data_service_seed=0))
+        d2 = _digest_stream(control)
+        assert d1 and d1 == d2
+        assert not it.degraded
+        # the "second trainer": same addresses again -> cache hits
+        hits_before = readers[0].cache_hits
+        d3 = _digest_stream(it, epoch=0)
+        assert d3 == d1
+        assert readers[0].cache_hits > hits_before
+        it.close()
+    finally:
+        for r in readers:
+            r.stop()
+
+
+@pytest.mark.quick
+def test_client_retries_through_data_fetch_failpoint():
+    """An armed ``data.fetch`` site fails the first attempt; the
+    retry policy absorbs it without a failover."""
+    endpoints, readers = _start_fleet(1)
+    try:
+        client = DataServiceClient(_svc(endpoints), SECTION)
+        failpoints.set_site("data.fetch", "once")
+        _header, batch = client.fetch(0, 0, 0)
+        assert batch is not None
+        assert failpoints.fired("data.fetch") == 1
+        assert client.failovers == 0
+        client.close()
+    finally:
+        for r in readers:
+            r.stop()
+
+
+@pytest.mark.quick
+def test_client_fails_over_once_then_survivor_serves():
+    """A reader answering an error frame (the ``data.serve`` site) is
+    treated like a dead endpoint: the client re-derives the shard map
+    over the survivors and the fetch succeeds elsewhere."""
+    endpoints, readers = _start_fleet(2)
+    try:
+        client = DataServiceClient(_svc(endpoints), SECTION)
+        shard0_owner = assign.owner_map(client.assignment)[0]
+        failpoints.set_site("data.serve", "once")
+        _header, batch = client.fetch(0, 0, 0)
+        assert batch is not None
+        assert client.failovers == 1
+        assert shard0_owner not in client.live
+        assert len(client.live) == 1
+        # the rebalanced map covers every shard with the survivor
+        assert sorted(assign.owner_map(client.assignment)) == \
+            list(range(client.n_shards))
+        client.close()
+    finally:
+        for r in readers:
+            r.stop()
+
+
+@pytest.mark.quick
+def test_position_survives_2to1_reader_loss_bit_exact():
+    """Kill one of two readers MID-EPOCH: the client rebalances onto
+    the survivor and the delivered stream stays bit-identical to the
+    uninterrupted control — position lives in the client, addressing
+    is deterministic, so a takeover reader recomputes the same
+    batches."""
+    control = build_service_iterator(
+        SECTION, _svc("local", data_service_seed=0))
+    want = _digest_stream(control)
+    endpoints, readers = _start_fleet(2)
+    try:
+        it = build_service_iterator(SECTION, _svc(endpoints))
+        it.before_first()
+        got = []
+        for _ in range(5):
+            b = it.next()
+            assert b is not None
+            got.append(hashlib.sha256(
+                np.ascontiguousarray(b.data).tobytes()
+                + np.ascontiguousarray(b.label).tobytes()).hexdigest())
+        readers[1].stop()                      # the mid-epoch loss
+        while True:
+            b = it.next()
+            if b is None:
+                break
+            got.append(hashlib.sha256(
+                np.ascontiguousarray(b.data).tobytes()
+                + np.ascontiguousarray(b.label).tobytes()).hexdigest())
+        assert got == want
+        assert not it.degraded                 # survivor absorbed it all
+        it.close()
+    finally:
+        for r in readers:
+            r.stop()
+
+
+@pytest.mark.quick
+def test_degrades_to_local_with_one_time_warning(capsys):
+    """No reader answers at all: one warning, one counter, and the
+    local pipeline serves the identical stream."""
+    dead = f"127.0.0.1:{_free_port()},127.0.0.1:{_free_port()}"
+    it = build_service_iterator(SECTION, _svc(dead))
+    d = _digest_stream(it)
+    control = build_service_iterator(
+        SECTION, _svc("local", data_service_seed=0))
+    assert d == _digest_stream(control)
+    assert it.degraded and it.client is None
+    warnings = [ln for ln in capsys.readouterr().out.splitlines()
+                if "degraded to the local input pipeline" in ln]
+    assert len(warnings) == 1
+
+
+@pytest.mark.quick
+def test_degrade_disabled_raises():
+    dead = f"127.0.0.1:{_free_port()}"
+    it = build_service_iterator(
+        SECTION, _svc(dead, data_service_local_fallback=0))
+    it.before_first()
+    with pytest.raises(NoReaderAvailable):
+        it.next()
+
+
+@pytest.mark.quick
+def test_dist_worker_keys_conflict_with_service():
+    """Configs carrying their own per-process data sharding cannot
+    compose with the service (every client consumes the full global
+    stream) — fail loud, never silently double-train the data."""
+    with pytest.raises(ValueError, match="dist_num_worker"):
+        build_service_iterator(
+            SECTION + [("dist_num_worker", "2"),
+                       ("dist_worker_rank", "0")],
+            _svc("local", data_service_seed=0))
+
+
+@pytest.mark.quick
+def test_malformed_ok_frame_takes_failover_ladder():
+    """A reader answering a structurally broken ok-frame must be
+    absorbed as an endpoint failure (failover, then degrade) — never
+    crash the train loop with a raw WireError/KeyError."""
+    dead = f"127.0.0.1:{_free_port()}"
+    client = DataServiceClient(_svc(dead), SECTION)
+    client._request_retrying = \
+        lambda ep, req: ({"status": "ok", "arrays": []}, {})
+    with pytest.raises(NoReaderAvailable):
+        client.fetch(0, 0, 0)
+
+
+@pytest.mark.quick
+def test_hard_fail_raises_through_prefetch_wrapper():
+    """local_fallback=0 under the prefetch thread must surface the
+    error on the CONSUMER side (the producer relays it through the
+    queue) — never hang the train loop behind a dead producer."""
+    dead = f"127.0.0.1:{_free_port()}"
+    it = build_service_iterator(
+        SECTION, _svc(dead, data_service_local_fallback=0,
+                      data_service_prefetch=2))
+    it.before_first()
+    with pytest.raises(NoReaderAvailable):
+        for _ in range(100):
+            if it.next() is None:
+                raise AssertionError("stream ended without the error")
+    it.close()
+
+
+@pytest.mark.quick
+def test_epoch_rebuild_releases_threadbuffer_producers():
+    """An abandoned (epoch, shard) cursor's threadbuffer producer is
+    joined by the rebuild — epoch changes must not accumulate spinning
+    io-threadbuffer threads."""
+    import threading
+    from cxxnet_tpu.data_service.pipeline import LocalShardSource as LSS
+
+    def tb_threads():
+        return [t for t in threading.enumerate()
+                if t.name == "io-threadbuffer" and t.is_alive()]
+
+    before = len(tb_threads())
+    sec = SECTION + [("iter", "threadbuffer")]
+    src = LSS(sec, 2, 0)
+    for epoch in range(3):              # each get() rebuilds the cursor
+        assert src.get(epoch, 0, 0) is not None
+    assert len(tb_threads()) <= before + 1
+    src.close()
+    t0 = time.time()
+    while len(tb_threads()) > before and time.time() - t0 < 5:
+        time.sleep(0.05)
+    assert len(tb_threads()) == before
+
+
+@pytest.mark.quick
+def test_set_epoch_aligns_resume_position():
+    """Two fresh iterators asked for the same epoch produce the same
+    stream (the elastic-resume replay contract), and epochs differ."""
+    svc = _svc("local", data_service_seed=11)
+    it1 = build_service_iterator(SECTION, svc)
+    it2 = build_service_iterator(SECTION, svc)
+    d_e3 = _digest_stream(it1, epoch=3)
+    assert _digest_stream(it2, epoch=3) == d_e3
+    assert it1.epoch == 3 and it1._next_epoch == 4
+    assert _digest_stream(it2) != d_e3      # epoch 4 next: new order+seed
+
+
+@pytest.mark.quick
+def test_epoch_interleave_has_no_shard_local_bias():
+    """Consecutive batches cycle DISTINCT shards in the epoch
+    permutation's order — never one shard drained then the next."""
+    svc = _svc("local", shards=4, data_service_seed=5)
+    it = build_service_iterator(SECTION, svc)
+    served = []
+    orig = it._get
+
+    def spy(epoch, shard, b):
+        served.append(shard)
+        return orig(epoch, shard, b)
+    it._get = spy
+    it.before_first()
+    while it.next() is not None:
+        pass
+    perm = assign.epoch_permutation(5, 0, 4)
+    # first cycle visits every shard once, in permuted order
+    assert served[:4] == perm
+    # and no shard appears twice before the others appear once
+    for i in range(0, 8, 4):
+        assert sorted(served[i:i + 4]) == [0, 1, 2, 3]
+
+
+@pytest.mark.quick
+def test_prefetched_wrapper_keeps_stream_and_epoch_contract():
+    """data_service_prefetch wraps the client in the threadbuffer
+    producer: same stream, set_epoch passthrough, clean teardown."""
+    endpoints, readers = _start_fleet(1)
+    try:
+        it = build_service_iterator(
+            SECTION, _svc(endpoints, data_service_prefetch=2))
+        from cxxnet_tpu.data_service.client import \
+            PrefetchedServiceIterator
+        assert isinstance(it, PrefetchedServiceIterator)
+        control = build_service_iterator(
+            SECTION, _svc("local", data_service_seed=0))
+        assert _digest_stream(it, epoch=1) == \
+            _digest_stream(control, epoch=1)
+        it.close()
+    finally:
+        for r in readers:
+            r.stop()
+
+
+@pytest.mark.quick
+def test_service_over_real_imgrec_pipeline(tmp_path):
+    """The production path: packed jpeg records, byte-range shards,
+    decode + augment in the reader — served stream digest-equal to the
+    control, and one epoch covers every instance exactly once (the
+    shards partition the record file)."""
+    import io as _io
+    from PIL import Image
+    from cxxnet_tpu.io.recordio import ImageRecord, RecordWriter
+    path = str(tmp_path / "t.rec")
+    with RecordWriter(path) as w:
+        for i in range(20):
+            y, x = np.mgrid[0:40, 0:52]
+            img = np.stack([(y * 3 + i) % 256, (x * 3) % 256,
+                            (y + x + i) % 256], -1).astype(np.uint8)
+            buf = _io.BytesIO()
+            Image.fromarray(img).save(buf, "JPEG", quality=95)
+            w.write(ImageRecord(
+                inst_id=i, labels=np.asarray([i % 4], np.float32),
+                data=buf.getvalue()).pack())
+    section = parse_config_string(f"""
+iter = imgrec
+image_rec = {path}
+input_shape = 3,32,32
+batch_size = 4
+rand_crop = 1
+rand_mirror = 1
+shuffle = 1
+silent = 1
+io_retry_attempts = 2
+io_retry_base_ms = 5
+""")
+    endpoints, readers = _start_fleet(1, shards=2, pairs=section)
+    try:
+        it = build_service_iterator(section, _svc(endpoints, shards=2))
+        it.before_first()
+        digests, insts = [], []
+        while True:
+            b = it.next()
+            if b is None:
+                break
+            digests.append(hashlib.sha256(
+                np.ascontiguousarray(b.data).tobytes()).hexdigest())
+            real = b.batch_size - b.num_batch_padd
+            insts.extend(int(v) for v in b.inst_index[:real])
+        assert sorted(insts) == list(range(20))
+        control = build_service_iterator(
+            section, _svc("local", shards=2))
+        control.before_first()
+        want = []
+        while True:
+            b = control.next()
+            if b is None:
+                break
+            want.append(hashlib.sha256(
+                np.ascontiguousarray(b.data).tobytes()).hexdigest())
+        assert digests == want
+        it.close()
+    finally:
+        for r in readers:
+            r.stop()
+
+
+@pytest.mark.quick
+def test_local_source_rebuilds_on_backward_seek():
+    src = LocalShardSource(SECTION, 3, 0)
+    b2 = src.get(0, 1, 2)
+    b0 = src.get(0, 1, 0)          # backward: deterministic rebuild
+    src2 = LocalShardSource(SECTION, 3, 0)
+    np.testing.assert_array_equal(b0.data, src2.get(0, 1, 0).data)
+    np.testing.assert_array_equal(b2.data, src2.get(0, 1, 2).data)
+    assert src.get(0, 1, 10**6) is None
+    assert src.length(0, 1) is not None
+
+
+@pytest.mark.quick
+def test_reader_publishes_status_registry(tmp_path):
+    d = str(tmp_path / "registry")
+    endpoints, readers = _start_fleet(
+        2, data_service_status_dir=d)
+    try:
+        import json
+        names = sorted(os.listdir(d))
+        assert names == ["reader_0.json", "reader_1.json"]
+        st = json.loads(open(os.path.join(d, "reader_0.json")).read())
+        assert st["n_shards"] == 3 and isinstance(st["owned"], list)
+    finally:
+        for r in readers:
+            r.stop()
+
+
+# -- config validation --------------------------------------------------------
+
+@pytest.mark.quick
+def test_parse_data_service_config_contract():
+    with pytest.raises(ConfigError):
+        parse_data_service_config([("data_service_shrads", "2")])  # typo
+    with pytest.raises(ConfigError):
+        parse_data_service_config([("data_service", "nocolon")])
+    with pytest.raises(ConfigError):
+        parse_data_service_config([("data_service", "local")])  # no shards
+    with pytest.raises(ConfigError):
+        parse_data_service_config([("data_service", "h:1"),
+                                   ("data_service_cache", "0")])
+    dc = parse_data_service_config([
+        ("data_service", "a:1, b:2"), ("data_service_seed", "9")])
+    assert dc.endpoint_list == ["a:1", "b:2"]
+    assert dc.n_shards == 2 and dc.seed == 9 and dc.enabled
+    assert not parse_data_service_config([]).enabled
+
+
+# -- the ROADMAP-5 proof criterion -------------------------------------------
+
+# the trainer must do REAL work per step or a CPU run can never leave
+# input-bound (device_block is ~0 on a synchronous CPU backend, so the
+# verdict compares data-wait against the 5%-of-wall floor): a wide
+# fullc makes one step ~tens of ms against a ~1 ms warm service fetch
+NET_CFG = """
+netconfig=start
+layer[+1:h1] = fullc:fc1
+  nhidden = 8192
+  random_type = xavier
+layer[+1:a1] = relu
+layer[a1->out] = fullc:fc2
+  nhidden = 5
+  random_type = xavier
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,64
+batch_size = 256
+eta = 0.05
+dev = cpu:0
+eval_train = 0
+print_step = 0
+silent = 1
+save_model = 0
+num_round = 2
+telemetry_sync_interval = 2
+io_retry_attempts = 2
+io_retry_base_ms = 5
+data = train
+iter = synthetic
+  num_inst = 1024
+  num_class = 5
+  input_shape = 1,1,64
+iter = end
+"""
+
+THROTTLE = """
+iter = throttle
+  throttle_ms = 25
+"""
+
+
+def _run_task(extra):
+    from cxxnet_tpu.main import LearnTask
+    cfg = NET_CFG.replace("iter = end", THROTTLE + "iter = end")
+    task = LearnTask(parse_config_string(cfg + extra))
+    task.run()
+    return task
+
+
+def test_steptime_verdict_flips_when_service_feeds_trainer():
+    """The ROADMAP-5 proof: a trainer behind a throttled local decode
+    is input-bound; the SAME trainer fed the same (addressed) batches
+    by a warmed reader is not — decode cost left the trainer."""
+    local = _run_task("")
+    assert local._steptime_probe is not None
+    assert local._steptime_probe.verdict() == "input-bound"
+
+    # a reader over the same throttled section, cache pre-warmed (the
+    # fleet pays decode once; this trainer never does)
+    section = parse_config_string(
+        NET_CFG.replace("iter = end", THROTTLE + "iter = end"))
+    port = _free_port()
+    svc_r = _svc(f"127.0.0.1:{port}", shards=2, data_service_reader=0,
+                 data_service_cache=512)
+    srv = DataReaderServer(section, svc_r, silent=True)
+    srv.start()
+    try:
+        warm = build_service_iterator(
+            section, _svc(f"127.0.0.1:{port}", shards=2))
+        for epoch in (0, 1):
+            _digest_stream(warm, epoch=epoch)
+        warm.close()
+        served = _run_task(
+            f"data_service = 127.0.0.1:{port}\n"
+            "data_service_shards = 2\n")
+        probe = served._steptime_probe
+        assert probe is not None
+        assert probe.verdict() in ("compute-bound", "balanced")
+        # and the input wait itself collapsed by an order of magnitude
+        assert probe.data_wait_ema < 0.25 * \
+            local._steptime_probe.data_wait_ema
+    finally:
+        srv.stop()

@@ -1,0 +1,284 @@
+#!/usr/bin/env python
+"""Input-data-service smoke (tier-1-adjacent; CPU-safe, two processes).
+
+Drives the disaggregated input plane end to end — the acceptance run:
+
+  1. Launch a READER process (``task = data_reader``) owning both
+     shards of a synthetic data section.
+  2. Prove the service contract in-process: client 1's full-epoch
+     stream is digest-equal to the local-pipeline control (fixed
+     seed), client 2 replays the same addresses and the reader's
+     cache-hit counter moves (decode paid once per fleet), and the
+     reader's atomically-published status registry names its shards.
+  3. Launch a TRAINER process (``task = train`` +
+     ``data_service = host:port``), SIGKILL the reader MID-RUN, and
+     assert the trainer degrades to the local pipeline without a
+     failed round: all rounds complete, rc 0, the one-time degrade
+     warning printed, and every round's loss is BIT-IDENTICAL to an
+     uninterrupted ``data_service = local`` control — the degrade
+     path serves the same deterministic stream the service did.
+  4. Assert the ledger timeline: reader ``dataservice_start`` with
+     its owned shards, trainer ``dataservice_degrade``.
+
+Exits nonzero on any failure.  Run:
+    JAX_PLATFORMS=cpu python tools/smoke_dataservice.py
+(sibling of tools/smoke_fleet.py / smoke_elastic.py / chaos_train.py)
+"""
+
+import hashlib
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+DATA_SECTION = """
+data = train
+iter = synthetic
+  num_inst = 256
+  num_class = 5
+  input_shape = 1,1,16
+iter = end
+"""
+
+NET_CFG = """
+netconfig=start
+layer[+1:h1] = fullc:fc1
+  nhidden = 24
+  random_type = xavier
+layer[+1:a1] = relu
+layer[a1->out] = fullc:fc2
+  nhidden = 5
+  random_type = xavier
+layer[+0] = softmax
+netconfig=end
+eta = 0.02
+eval_train = 0
+print_step = 0
+metric = error
+"""
+
+COMMON = """
+input_shape = 1,1,16
+batch_size = 32
+dev = cpu
+silent = 1
+save_model = 0
+io_retry_attempts = 2
+io_retry_base_ms = 5
+io_retry_max_ms = 50
+data_service_shards = 2
+data_service_timeout_ms = 2000
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _write_conf(td, name, text):
+    path = os.path.join(td, name)
+    with open(path, "w") as f:
+        f.write(text)
+    return path
+
+
+def _spawn(conf, log_path):
+    log = open(log_path, "w")
+    return subprocess.Popen(
+        [sys.executable, "-m", "cxxnet_tpu.main", conf],
+        cwd=_REPO, stdout=log, stderr=subprocess.STDOUT,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+
+
+def _wait_for_reader(client, endpoint, timeout_s=60.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout_s:
+        try:
+            return client.meta(endpoint)
+        except OSError:
+            time.sleep(0.25)
+    raise AssertionError(f"reader at {endpoint} never answered meta")
+
+
+def _digest_epochs(it, epochs):
+    out = []
+    for e in epochs:
+        it.set_epoch(e)
+        it.before_first()
+        while True:
+            b = it.next()
+            if b is None:
+                break
+            import numpy as np
+            out.append(hashlib.sha256(
+                np.ascontiguousarray(b.data).tobytes()
+                + np.ascontiguousarray(b.label).tobytes()).hexdigest())
+    return out
+
+
+def _round_losses(ledger_path, run_filter=None):
+    """{round: loss} from round_end events of one ledger file."""
+    out = {}
+    with open(ledger_path) as f:
+        for line in f:
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue
+            if ev.get("event") == "round_end":
+                out[int(ev["round"])] = ev.get("loss")
+    return out
+
+
+def main() -> int:
+    from cxxnet_tpu.config import parse_config_string, \
+        parse_data_service_config
+    from cxxnet_tpu.data_service.client import (DataServiceClient,
+                                                build_service_iterator)
+
+    td = tempfile.mkdtemp(prefix="smoke_dataservice_")
+    port = _free_port()
+    endpoint = f"127.0.0.1:{port}"
+    status_dir = os.path.join(td, "registry")
+    reader_ledger = os.path.join(td, "reader.jsonl")
+
+    # -- 1: the reader process -------------------------------------------
+    reader_conf = _write_conf(td, "reader.conf", (
+        "task = data_reader\n"
+        f"data_service = {endpoint}\n"
+        "data_service_reader = 0\n"
+        f"data_service_status_dir = {status_dir}\n"
+        f"telemetry_ledger = {reader_ledger}\n"
+        + COMMON + DATA_SECTION))
+    reader = _spawn(reader_conf, os.path.join(td, "reader.log"))
+
+    svc_pairs = [("data_service", endpoint), ("data_service_shards", "2"),
+                 ("data_service_prefetch", "0")]
+    svc = parse_data_service_config(svc_pairs)
+    section = parse_config_string(COMMON + DATA_SECTION.replace(
+        "data = train", "").replace("iter = end", ""))
+    client = DataServiceClient(svc, section)
+    try:
+        meta = _wait_for_reader(client, endpoint)
+        assert meta["n_shards"] == 2 and meta["owned"] == [0, 1], meta
+
+        # -- 2: two clients, one decode --------------------------------
+        it1 = build_service_iterator(section, svc)
+        d1 = _digest_epochs(it1, (0, 1))
+        it1.close()
+        control = parse_data_service_config(
+            [("data_service", "local"), ("data_service_shards", "2")])
+        d_ctl = _digest_epochs(
+            build_service_iterator(section, control), (0, 1))
+        assert d1 == d_ctl and d1, (
+            f"service stream != local control ({len(d1)} vs "
+            f"{len(d_ctl)} batches)")
+        print(f"smoke_dataservice: client 1 drew {len(d1)} batches, "
+              "digest-equal to the local-pipeline control")
+
+        hits_before = client.stats(endpoint)["cache_hits"]
+        it2 = build_service_iterator(section, svc)
+        d2 = _digest_epochs(it2, (0, 1))
+        it2.close()
+        stats = client.stats(endpoint)
+        assert d2 == d1, "second client saw a different stream"
+        assert stats["cache_hits"] > hits_before, (
+            f"second client produced no cache hits: {stats}")
+        print(f"smoke_dataservice: client 2 digest-equal, cache hits "
+              f"{hits_before} -> {stats['cache_hits']} "
+              f"(served {stats['served']})")
+
+        st_file = os.path.join(status_dir, "reader_0.json")
+        st = json.loads(open(st_file).read())
+        assert st["owned"] == [0, 1] and st["n_shards"] == 2, st
+        print(f"smoke_dataservice: status registry ok ({st_file})")
+
+        # -- 3: trainer + mid-run SIGKILL of the reader ------------------
+        trainer_ledger = os.path.join(td, "trainer.jsonl")
+        trainer_conf = _write_conf(td, "trainer.conf", (
+            "task = train\n"
+            f"data_service = {endpoint}\n"
+            "num_round = 6\n"
+            f"model_dir = {os.path.join(td, 'models')}\n"
+            f"telemetry_ledger = {trainer_ledger}\n"
+            + COMMON + NET_CFG + DATA_SECTION))
+        tlog = os.path.join(td, "trainer.log")
+        trainer = _spawn(trainer_conf, tlog)
+        # kill the reader once the trainer has completed a round THROUGH
+        # the service (mid-run by construction)
+        t0 = time.time()
+        while time.time() - t0 < 120:
+            if os.path.exists(tlog) and "round        0:" in open(tlog).read():
+                break
+            if trainer.poll() is not None:
+                break
+            time.sleep(0.25)
+        else:
+            raise AssertionError("trainer never finished round 0")
+        os.kill(reader.pid, signal.SIGKILL)
+        reader.wait()
+        print("smoke_dataservice: reader SIGKILLed after trainer "
+              "round 0")
+        rc = trainer.wait(timeout=300)
+        tout = open(tlog).read()
+        assert rc == 0, f"trainer rc={rc}\n{tout[-2000:]}"
+        for r in range(6):
+            assert f"round        {r}:" in tout, \
+                f"round {r} line missing\n{tout[-2000:]}"
+        assert "degraded to the local input pipeline" in tout, (
+            "degrade warning missing from trainer output\n"
+            + tout[-2000:])
+
+        # -- 3b: loss parity vs an uninterrupted local control -----------
+        control_ledger = os.path.join(td, "control.jsonl")
+        control_conf = _write_conf(td, "control.conf", (
+            "task = train\n"
+            "data_service = local\n"
+            "num_round = 6\n"
+            f"model_dir = {os.path.join(td, 'models_ctl')}\n"
+            f"telemetry_ledger = {control_ledger}\n"
+            + COMMON + NET_CFG + DATA_SECTION))
+        ctl = _spawn(control_conf, os.path.join(td, "control.log"))
+        assert ctl.wait(timeout=300) == 0
+        got = _round_losses(trainer_ledger)
+        want = _round_losses(control_ledger)
+        assert sorted(got) == list(range(6)), f"trainer rounds {got}"
+        assert got == want, (
+            "degraded trainer's losses diverge from the local control:"
+            f"\n  service+kill: {got}\n  control:      {want}")
+        assert all(v is not None for v in got.values()), got
+        print("smoke_dataservice: 6/6 rounds complete through the "
+              "SIGKILL, losses bit-identical to the uninterrupted "
+              f"local control ({[round(v, 6) for _, v in sorted(got.items())]})")
+
+        # -- 4: ledger timeline ------------------------------------------
+        starts = [json.loads(l) for l in open(reader_ledger)
+                  if '"dataservice_start"' in l]
+        assert starts and starts[0]["owned"] == [0, 1], starts
+        degrades = [json.loads(l) for l in open(trainer_ledger)
+                    if '"dataservice_degrade"' in l]
+        assert len(degrades) == 1, degrades
+        print("smoke_dataservice: ledger timeline ok "
+              "(dataservice_start + one dataservice_degrade)")
+        print("smoke_dataservice: PASS")
+        return 0
+    finally:
+        client.close()
+        if reader.poll() is None:
+            reader.kill()
+            reader.wait()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
