@@ -49,7 +49,7 @@ from ..resilience.failpoints import InjectedFault
 from ..resilience import failpoints
 from ..telemetry.ledger import LEDGER
 from ..telemetry.registry import REGISTRY
-from . import assign, wire
+from . import assign, pipeline, wire
 from .pipeline import LocalShardSource
 
 
@@ -210,6 +210,10 @@ class ServiceIterator(DataIter):
         self.svc = svc
         self.silent = silent
         self.n_shards = svc.n_shards
+        # validate NOW, even in remote mode: the degrade path builds
+        # local pipelines mid-train, far too late to learn the section
+        # cannot shard
+        pipeline.check_shardable(self.pairs, self.n_shards)
         self.client: Optional[DataServiceClient] = None
         if not svc.local_only:
             self.client = DataServiceClient(svc, self.pairs)

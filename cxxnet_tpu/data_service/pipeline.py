@@ -31,12 +31,35 @@ import dataclasses
 from typing import Dict, Optional, Tuple
 
 from ..config import ConfigPairs
-from ..io.data import DataBatch, DataIter, create_iterator
+# close_chain re-exported: a cursor abandoned by an epoch rebuild must
+# not leak a spinning producer or an 8-thread executor per (epoch, shard)
+from ..io.data import (DataBatch, DataIter, close_chain,  # noqa: F401
+                       create_iterator, dist_shardable_sources)
 from .assign import stream_seed
 
 #: config keys owned by the service namespace, stripped before the
 #: section reaches the ordinary iterator chain
 _SERVICE_PREFIX = "data_service"
+
+def check_shardable(pairs: ConfigPairs, n_shards: int) -> None:
+    """Raise unless the section's SOURCE iterator (the first ``iter``
+    entry — later entries wrap it) declares ``supports_dist_shard``
+    (honors dist_num_worker/dist_worker_rank). Any other source would
+    silently serve its FULL stream per (epoch, shard) pipeline —
+    n_shards x sample duplication per epoch — so the service refuses
+    such sections up front (reader startup and client construction,
+    never mid-train). With one shard any source is trivially whole."""
+    if n_shards <= 1:
+        return
+    kinds = [v for k, v in pairs if k == "iter" and v != "end"]
+    shardable = dist_shardable_sources()
+    if kinds and kinds[0] not in shardable:
+        raise ValueError(
+            f"data_service_shards={n_shards} needs a source iterator "
+            f"that honors dist_num_worker/dist_worker_rank; "
+            f"'{kinds[0]}' does not (shardable: "
+            f"{', '.join(shardable)}). Use one of "
+            "those or set data_service_shards = 1.")
 
 
 def shard_section(pairs: ConfigPairs, n_shards: int, shard: int,
@@ -48,30 +71,15 @@ def shard_section(pairs: ConfigPairs, n_shards: int, shard: int,
     if not 0 <= shard < n_shards:
         raise ValueError(f"shard {shard} outside [0, {n_shards})")
     base = [(k, v) for k, v in pairs if not k.startswith(_SERVICE_PREFIX)]
+    # data_gen_seed pins GENERATED sources (synthetic/_lm) to one
+    # shard- and epoch-independent dataset; the per-(epoch, shard)
+    # seed_data then only orders rows — file-backed sources get the
+    # same split for free (data identity from the file)
     base += [("dist_num_worker", str(int(n_shards))),
              ("dist_worker_rank", str(int(shard))),
-             ("seed_data", str(stream_seed(seed, epoch, shard)))]
+             ("seed_data", str(stream_seed(seed, epoch, shard))),
+             ("data_gen_seed", str(int(seed)))]
     return base
-
-
-def close_chain(it) -> None:
-    """Release a pipeline chain's background resources: threadbuffer
-    producers (``close()``) and decode thread pools (``_pool``). A
-    cursor abandoned by an epoch rebuild must not leak a spinning
-    producer or an 8-thread executor per (epoch, shard)."""
-    seen = set()
-    while it is not None and id(it) not in seen:
-        seen.add(id(it))
-        close = getattr(it, "close", None)
-        if callable(close):
-            try:
-                close()
-            except Exception:
-                pass
-        pool = getattr(it, "_pool", None)
-        if pool is not None and hasattr(pool, "shutdown"):
-            pool.shutdown(wait=False)
-        it = getattr(it, "base", None)
 
 
 @dataclasses.dataclass
@@ -91,6 +99,7 @@ class LocalShardSource:
     shard, the client owns it from a single thread."""
 
     def __init__(self, pairs: ConfigPairs, n_shards: int, seed: int):
+        check_shardable(pairs, n_shards)
         self.pairs = list(pairs)
         self.n_shards = int(n_shards)
         self.seed = int(seed)

@@ -42,6 +42,13 @@ class DataBatch:
 class DataIter:
     """Iterator protocol (reference IIterator, data.h:19-39)."""
 
+    #: True on SOURCE iterators that honor dist_num_worker /
+    #: dist_worker_rank (serve a 1/nworker row slice). Declared on the
+    #: implementing class so the data service's shardability check can
+    #: never drift from the code: dist_shardable_sources() derives the
+    #: allowed set from the registry.
+    supports_dist_shard = False
+
     def __init__(self, cfg: ConfigPairs):
         self.cfg = cfg
         for k, v in cfg:
@@ -67,6 +74,40 @@ class DataIter:
             if b is None:
                 return
             yield b
+
+
+def close_chain(it) -> None:
+    """Release an iterator chain's background resources, walking
+    ``.base`` links: threadbuffer producers (``close()``) and decode
+    thread pools (``_pool``). The teardown for ANY chain — wrappers
+    need not each forward close() for an abandoned chain to avoid
+    leaking a spinning producer or an 8-thread executor."""
+    seen = set()
+    while it is not None and id(it) not in seen:
+        seen.add(id(it))
+        close = getattr(it, "close", None)
+        if callable(close):
+            try:
+                close()
+            except Exception:
+                pass
+        pool = getattr(it, "_pool", None)
+        if pool is not None and hasattr(pool, "shutdown"):
+            pool.shutdown(wait=False)
+        it = getattr(it, "base", None)
+
+
+def dist_slice(n: int, nworker: int, rank: int) -> slice:
+    """Contiguous row range of worker ``rank`` of ``nworker`` over
+    ``n`` rows — the imgrec byte-range rule applied to row-indexed
+    sources (first ``n % nworker`` workers carry one extra row), so
+    the union over ranks is exactly the full dataset."""
+    if not 0 <= rank < nworker:
+        raise ValueError(f"dist_worker_rank {rank} outside "
+                         f"[0, dist_num_worker={nworker})")
+    base, extra = divmod(n, nworker)
+    start = rank * base + min(rank, extra)
+    return slice(start, start + base + (1 if rank < extra else 0))
 
 
 ITER_REGISTRY: Dict[str, Type[DataIter]] = {}
@@ -125,6 +166,13 @@ class SkipReadIterator(DataIter):
             return None
         self._pos += 1
         return self._first
+
+
+def dist_shardable_sources() -> list:
+    """Source iterator types declaring ``supports_dist_shard``."""
+    from . import proc, iter_imgrec, iter_img  # noqa: F401  (populate registry)
+    return sorted(n for n, c in ITER_REGISTRY.items()
+                  if c.supports_dist_shard)
 
 
 def create_iterator(cfg: ConfigPairs) -> DataIter:

@@ -94,6 +94,8 @@ def expand_conf_files(prefix: str, ids: str, rank: int, nworker: int):
 class ImageRecordIterator(DataIter):
     """Batched, augmented, sharded image-record reader."""
 
+    supports_dist_shard = True
+
     def set_param(self, name, val):
         if name in ("image_rec", "path_imgrec"):
             self.rec_path = val

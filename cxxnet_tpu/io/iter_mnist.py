@@ -14,7 +14,7 @@ from typing import Optional
 
 import numpy as np
 
-from .data import DataBatch, DataIter, register_iter
+from .data import DataBatch, DataIter, dist_slice, register_iter
 from .stream import open_maybe_gz as _open_maybe_gz_stream
 
 
@@ -35,6 +35,8 @@ def read_idx(path: str) -> np.ndarray:
 
 @register_iter("mnist")
 class MNISTIterator(DataIter):
+    supports_dist_shard = True
+
     def set_param(self, name, val):
         if name == "path_img":
             self.path_img = val
@@ -52,6 +54,10 @@ class MNISTIterator(DataIter):
             self.round_batch = int(val)
         elif name == "silent":
             self.silent = int(val)
+        elif name == "dist_num_worker":
+            self.nworker = int(val)
+        elif name == "dist_worker_rank":
+            self.rank = int(val)
         elif name == "index_offset":
             # base added to instance indices (reference
             # iter_mnist-inl.hpp:33 inst_offset_) — aligns ids with
@@ -68,6 +74,8 @@ class MNISTIterator(DataIter):
         self.round_batch = 0
         self.silent = 0
         self.index_offset = 0
+        self.nworker = 1
+        self.rank = 0
         super().__init__(cfg)
 
     def init(self):
@@ -81,7 +89,12 @@ class MNISTIterator(DataIter):
             self.images = images.reshape(n, h, w, 1)
         self.labels = labels.reshape(n, 1)
         self.inst = np.arange(n, dtype=np.int64) + self.index_offset
-        self._order = np.arange(n)
+        if self.nworker > 1:
+            sl = dist_slice(n, self.nworker, self.rank)
+            self.images = self.images[sl]
+            self.labels = self.labels[sl]
+            self.inst = self.inst[sl]    # ids stay global
+        self._order = np.arange(self.images.shape[0])
         self._rng = np.random.RandomState(self.seed)
         self.before_first()
         if not self.silent:

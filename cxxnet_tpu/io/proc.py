@@ -26,7 +26,7 @@ from typing import List, Optional
 
 import numpy as np
 
-from .data import DataBatch, DataIter, register_iter
+from .data import DataBatch, DataIter, dist_slice, register_iter
 from ..telemetry.registry import REGISTRY
 from . import iter_mnist  # noqa: F401  (register mnist)
 
@@ -187,6 +187,13 @@ class ThrottleIterator(DataIter):
             time.sleep(self.throttle_ms / 1e3)
         return self.base.next()
 
+    def close(self):
+        # chain-teardown contract: the top iterator's close() must
+        # reach a wrapped threadbuffer's producer thread
+        base_close = getattr(self.base, "close", None)
+        if callable(base_close):
+            base_close()
+
 
 @register_iter("membuffer")
 class DenseBufferIterator(DataIter):
@@ -230,6 +237,8 @@ class CSVIterator(DataIter):
     """CSV with label_width leading label columns then features
     (iter_csv-inl.hpp:14-112); optional input_shape to reshape features."""
 
+    supports_dist_shard = True
+
     def set_param(self, name, val):
         if name == "filename" or name == "path_csv":
             self.filename = val
@@ -245,6 +254,10 @@ class CSVIterator(DataIter):
             self.seed = int(val)
         elif name == "has_header":
             self.has_header = int(val)
+        elif name == "dist_num_worker":
+            self.nworker = int(val)
+        elif name == "dist_worker_rank":
+            self.rank = int(val)
 
     def __init__(self, cfg):
         self.filename = ""
@@ -254,6 +267,9 @@ class CSVIterator(DataIter):
         self.input_shape = None
         self.seed = 0
         self.has_header = 0
+        self.nworker = 1
+        self.rank = 0
+        self._inst_base = 0
         super().__init__(cfg)
 
     def init(self):
@@ -269,7 +285,12 @@ class CSVIterator(DataIter):
             self.data = feats.reshape(n, c, y, x).transpose(0, 2, 3, 1).copy()
         else:
             self.data = feats.reshape(n, 1, 1, -1)
-        self._order = np.arange(n)
+        if self.nworker > 1:
+            sl = dist_slice(n, self.nworker, self.rank)
+            self.data = self.data[sl]
+            self.labels = self.labels[sl]
+            self._inst_base = sl.start
+        self._order = np.arange(self.data.shape[0])
         self._rng = np.random.RandomState(self.seed)
         self.before_first()
 
@@ -291,13 +312,44 @@ class CSVIterator(DataIter):
         self._pos += bs
         return DataBatch(data=self.data[idx], label=self.labels[idx],
                          num_batch_padd=padd,
-                         inst_index=idx.astype(np.int64))
+                         inst_index=(idx + self._inst_base).astype(np.int64))
 
 
 class _InMemoryIterator(DataIter):
     """Shared sequential batch cursor over in-memory ``self.data`` /
     ``self.labels`` arrays with tail-padding (num_batch_padd); subclasses
-    implement ``init()`` to fill the arrays."""
+    implement ``init()`` to fill the arrays — generated from
+    ``data_gen_seed`` when set, else ``seed_data`` — and call
+    ``_finalize_rows()`` afterwards.
+
+    The data service's shard dimension: ``dist_num_worker`` /
+    ``dist_worker_rank`` keep only this worker's contiguous row range,
+    and when ``data_gen_seed`` is present (service mode: generation
+    pinned shard- and epoch-independent) ``seed_data`` only SHUFFLES
+    the slice — so the union over shards is exactly one dataset per
+    epoch, within-shard order varies per (epoch, shard), and
+    ``inst_index`` stays globally unique. That is imgrec's contract:
+    data identity from the source, seed_data for ordering."""
+
+    supports_dist_shard = True
+    nworker = 1
+    rank = 0
+    gen_seed = None
+
+    def _finalize_rows(self):
+        n = self.data.shape[0]
+        self.inst = np.arange(n, dtype=np.int64)
+        if self.nworker > 1:
+            sl = dist_slice(n, self.nworker, self.rank)
+            self.data = self.data[sl]
+            self.labels = self.labels[sl]
+            self.inst = self.inst[sl]
+        if self.gen_seed is not None:
+            p = np.random.RandomState(self.seed) \
+                .permutation(self.data.shape[0])
+            self.data = self.data[p]
+            self.labels = self.labels[p]
+            self.inst = self.inst[p]
 
     def before_first(self):
         self._pos = 0
@@ -314,7 +366,7 @@ class _InMemoryIterator(DataIter):
             idx = np.concatenate([idx, np.repeat(idx[-1:], padd)])
         self._pos += bs
         return DataBatch(data=self.data[idx], label=self.labels[idx],
-                         num_batch_padd=padd, inst_index=idx.astype(np.int64))
+                         num_batch_padd=padd, inst_index=self.inst[idx])
 
 
 @register_iter("synthetic")
@@ -336,6 +388,12 @@ class SyntheticIterator(_InMemoryIterator):
             self.seed = int(val)
         elif name == "label_width":
             self.label_width = int(val)
+        elif name == "dist_num_worker":
+            self.nworker = int(val)
+        elif name == "dist_worker_rank":
+            self.rank = int(val)
+        elif name == "data_gen_seed":
+            self.gen_seed = int(val)
 
     def __init__(self, cfg):
         self.num_inst = 512
@@ -347,7 +405,8 @@ class SyntheticIterator(_InMemoryIterator):
         super().__init__(cfg)
 
     def init(self):
-        rng = np.random.RandomState(self.seed)
+        rng = np.random.RandomState(
+            self.seed if self.gen_seed is None else self.gen_seed)
         c, y, x = self.input_shape
         dim = c * y * x
         centers = rng.randn(self.num_class, dim).astype(np.float32) * 2.0
@@ -360,6 +419,7 @@ class SyntheticIterator(_InMemoryIterator):
                 .transpose(0, 2, 3, 1).copy()
         self.labels = np.tile(lab.astype(np.float32)[:, None],
                               (1, self.label_width))
+        self._finalize_rows()
         self.before_first()
 
 
@@ -385,6 +445,12 @@ class SyntheticLMIterator(_InMemoryIterator):
             if val not in ("add0", "copy"):
                 raise ValueError(f"unknown lm_task {val!r}")
             self.lm_task = val
+        elif name == "dist_num_worker":
+            self.nworker = int(val)
+        elif name == "dist_worker_rank":
+            self.rank = int(val)
+        elif name == "data_gen_seed":
+            self.gen_seed = int(val)
 
     def __init__(self, cfg):
         self.num_inst = 512
@@ -396,7 +462,8 @@ class SyntheticLMIterator(_InMemoryIterator):
         super().__init__(cfg)
 
     def init(self):
-        rng = np.random.RandomState(self.seed)
+        rng = np.random.RandomState(
+            self.seed if self.gen_seed is None else self.gen_seed)
         toks = rng.randint(0, self.vocab_size,
                            size=(self.num_inst, self.seq_len))
         if self.lm_task == "copy":      # fast-learnable (no attention needed)
@@ -406,4 +473,5 @@ class SyntheticLMIterator(_InMemoryIterator):
         self.data = toks.astype(np.float32) \
             .reshape(self.num_inst, 1, 1, self.seq_len)
         self.labels = lab.astype(np.float32)
+        self._finalize_rows()
         self.before_first()

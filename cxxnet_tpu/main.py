@@ -24,7 +24,7 @@ from .config import (ConfigPairs, parse_cli_overrides, parse_ckpt_config,
                      parse_elastic_config, parse_retry_policy,
                      parse_telemetry_config)
 from .graph import global_param
-from .io.data import DataBatch, create_iterator
+from .io.data import DataBatch, close_chain, create_iterator
 from .resilience import SentinelAbort, TrainingSentinel, counters, failpoints
 from .telemetry import TelemetrySession
 from .telemetry.ledger import LEDGER, config_hash
@@ -413,9 +413,10 @@ class LearnTask:
         try:
             self._train_rounds(tr, itr_train, evals)
         finally:
-            # a data-service iterator owns sockets + a prefetch thread
-            if hasattr(itr_train, "close"):
-                itr_train.close()
+            # a data-service iterator owns sockets + a prefetch
+            # thread; any chain can hide a threadbuffer producer —
+            # close_chain walks .base so no wrapper has to forward
+            close_chain(itr_train)
             # finalize the trace even when the loop dies mid-round — the
             # crashing/interrupted run is the one whose profile matters
             if self.profile_dir:
@@ -631,8 +632,7 @@ class LearnTask:
                     # every stint builds a fresh train iterator; a
                     # dropped data-service one would keep fetching the
                     # in-flight epoch (sockets + prefetch thread)
-                    if hasattr(itr_train, "close"):
-                        itr_train.close()
+                    close_chain(itr_train)
                 self._elastic_finish(tr, coord)
                 return
         except Preempted:

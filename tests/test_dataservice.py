@@ -159,6 +159,117 @@ def test_stream_seed_deterministic_and_uncorrelated():
     assert assign.stream_seed(3, 1, 2) == assign.stream_seed(3, 1, 2)
 
 
+# -- shard slicing (dist_num_worker/dist_worker_rank in the sources) ---------
+
+@pytest.mark.quick
+def test_dist_slice_partitions_rows():
+    from cxxnet_tpu.io.data import dist_slice
+    for n, w in ((10, 2), (10, 3), (7, 7), (5, 8), (96, 3)):
+        rows = [list(range(n)[dist_slice(n, w, r)]) for r in range(w)]
+        flat = [i for part in rows for i in part]
+        assert flat == list(range(n)), (n, w, rows)   # disjoint + complete
+    with pytest.raises(ValueError):
+        dist_slice(10, 2, 2)
+
+
+@pytest.mark.quick
+def test_service_epoch_not_duplicated_across_shards():
+    """One service epoch carries the NOMINAL dataset size: each (epoch,
+    shard) pipeline serves a 1/n_shards row slice, not the full stream
+    (n_shards x duplication was the pre-fix failure for non-imgrec
+    sources)."""
+    svc = _svc("local", shards=3)
+    it = build_service_iterator(SECTION, svc)
+    rows, seen = 0, {}
+    it.before_first()
+    while True:
+        b = it.next()
+        if b is None:
+            break
+        keep = b.batch_size - b.num_batch_padd
+        rows += keep
+        for i in range(keep):
+            seen[int(b.inst_index[i])] = b.data[i].ravel().copy()
+    it.close()
+    assert rows == 96                       # num_inst, once — not 3x
+    assert sorted(seen) == list(range(96))  # globally unique ids
+    # coherence: every shard slices the SAME dataset — the one a plain
+    # iterator generates from the service seed (data_gen_seed pins
+    # generation; the per-(epoch, shard) seed_data only orders)
+    from cxxnet_tpu.io.data import create_iterator
+    ref = create_iterator(list(SECTION)
+                          + [("seed_data", str(svc.seed))])
+    gid = 0
+    for b in ref:
+        for i in range(b.batch_size - b.num_batch_padd):
+            np.testing.assert_array_equal(seen[gid], b.data[i].ravel())
+            gid += 1
+    assert gid == 96
+
+
+@pytest.mark.quick
+def test_service_synthetic_epochs_share_dataset_vary_order():
+    """imgrec's contract for generated sources: data identity is
+    epoch-independent (data_gen_seed), seed_data only shuffles."""
+    src = LocalShardSource(SECTION, 3, 0)
+
+    def rows(epoch, shard):
+        out, b = [], 0
+        while True:
+            batch = src.get(epoch, shard, b)
+            if batch is None:
+                return out
+            keep = batch.batch_size - batch.num_batch_padd
+            out.extend((int(batch.inst_index[i]), batch.data[i].tobytes())
+                       for i in range(keep))
+            b += 1
+
+    e0, e1 = rows(0, 1), rows(1, 1)
+    src.close()
+    assert sorted(e0) == sorted(e1)   # the same 32 rows...
+    assert e0 != e1                   # ...in a fresh per-epoch order
+
+
+@pytest.mark.quick
+def test_csv_dist_slice_partitions_file(tmp_path):
+    from cxxnet_tpu.io.data import create_iterator
+    path = tmp_path / "rows.csv"
+    rng = np.random.RandomState(0)
+    full = np.hstack([np.arange(10, dtype=np.float32)[:, None],
+                      rng.randn(10, 4).astype(np.float32)])
+    np.savetxt(path, full, delimiter=",")
+    base = [("iter", "csv"), ("filename", str(path)),
+            ("label_width", "1"), ("batch_size", "4"), ("iter", "end")]
+    seen = {}
+    for rank in (0, 1):
+        itr = create_iterator(base + [("dist_num_worker", "2"),
+                                      ("dist_worker_rank", str(rank))])
+        for b in itr:
+            keep = b.batch_size - b.num_batch_padd
+            for i in range(keep):
+                seen[int(b.inst_index[i])] = (
+                    float(b.label[i, 0]), b.data[i].ravel().copy())
+    assert sorted(seen) == list(range(10))  # both workers cover the file once
+    for gid, (lab, feats) in seen.items():
+        assert lab == full[gid, 0]
+        np.testing.assert_array_equal(feats, full[gid, 1:])
+
+
+@pytest.mark.quick
+def test_service_rejects_unshardable_source():
+    section = parse_config_string("""
+iter = img
+image_list = /nonexistent.lst
+batch_size = 4
+""")
+    with pytest.raises(ValueError, match="dist_num_worker"):
+        build_service_iterator(section, _svc("local", shards=2))
+    with pytest.raises(ValueError, match="dist_num_worker"):
+        LocalShardSource(section, 2, seed=1)
+    # one shard is trivially whole: any source is acceptable
+    LocalShardSource(section, 1, seed=1).close()
+
+
 # -- wire protocol ------------------------------------------------------------
 
 @pytest.mark.quick
@@ -526,11 +637,11 @@ io_retry_base_ms = 5
 @pytest.mark.quick
 def test_local_source_rebuilds_on_backward_seek():
     src = LocalShardSource(SECTION, 3, 0)
-    b2 = src.get(0, 1, 2)
+    b1 = src.get(0, 1, 1)          # a shard holds 96/3 rows = 2 batches
     b0 = src.get(0, 1, 0)          # backward: deterministic rebuild
     src2 = LocalShardSource(SECTION, 3, 0)
     np.testing.assert_array_equal(b0.data, src2.get(0, 1, 0).data)
-    np.testing.assert_array_equal(b2.data, src2.get(0, 1, 2).data)
+    np.testing.assert_array_equal(b1.data, src2.get(0, 1, 1).data)
     assert src.get(0, 1, 10**6) is None
     assert src.length(0, 1) is not None
 

@@ -44,6 +44,8 @@ iter = synthetic
   num_inst = 256
   num_class = 5
   input_shape = 1,1,16
+iter = throttle
+  throttle_ms = 80
 iter = end
 """
 
@@ -98,7 +100,7 @@ def _spawn(conf, log_path):
     return subprocess.Popen(
         [sys.executable, "-m", "cxxnet_tpu.main", conf],
         cwd=_REPO, stdout=log, stderr=subprocess.STDOUT,
-        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONUNBUFFERED="1"))
 
 
 def _wait_for_reader(client, endpoint, timeout_s=60.0):
@@ -127,7 +129,7 @@ def _digest_epochs(it, epochs):
     return out
 
 
-def _round_losses(ledger_path, run_filter=None):
+def _round_losses(ledger_path):
     """{round: loss} from round_end events of one ledger file."""
     out = {}
     with open(ledger_path) as f:
@@ -216,7 +218,11 @@ def main() -> int:
         tlog = os.path.join(td, "trainer.log")
         trainer = _spawn(trainer_conf, tlog)
         # kill the reader once the trainer has completed a round THROUGH
-        # the service (mid-run by construction)
+        # the service (mid-run by construction).  The window is sized in
+        # round-time, not wall-clock: the throttle stage makes every
+        # uncached round cost >= 8 batches x throttle_ms, so the kill
+        # lands before the last round even if the poll slips a tick
+        # (PYTHONUNBUFFERED keeps the round-0 log line prompt).
         t0 = time.time()
         while time.time() - t0 < 120:
             if os.path.exists(tlog) and "round        0:" in open(tlog).read():
