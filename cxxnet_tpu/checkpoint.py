@@ -46,6 +46,7 @@ import numpy as np
 
 from .io import stream
 from .resilience import counters, failpoints
+from .telemetry.disttrace import DISTTRACE
 from .telemetry.ledger import LEDGER
 from .telemetry.registry import REGISTRY
 from .telemetry.trace import TRACER
@@ -113,24 +114,29 @@ def save_model(path: str, *, structure_sig: tuple, round_counter: int,
                epoch_counter: int, params: Any, net_state: Any,
                opt_state: Optional[Any] = None, step_count: int = 0,
                lr_scale: float = 1.0) -> None:
-    t0 = time.perf_counter()
-    ok = False
-    try:
-        _save_model(path, structure_sig=structure_sig,
-                    round_counter=round_counter,
-                    epoch_counter=epoch_counter, params=params,
-                    net_state=net_state, opt_state=opt_state,
-                    step_count=step_count, lr_scale=lr_scale)
-        ok = True
-    finally:
-        # span + histogram recorded on the WRITING thread (covers the
-        # save_async path too); failures still count their duration
-        t1 = time.perf_counter()
-        _H_CKPT.labels("save").observe(t1 - t0)
-        TRACER.add_complete("ckpt.save", t0, t1, cat="ckpt",
-                            args={"round": round_counter})
-        LEDGER.event("ckpt_save", round=round_counter, path=path,
-                     seconds=round(t1 - t0, 4), ok=ok)
+    # distributed-trace root for the save: the ckpt_save ledger event
+    # emitted in the finally block runs INSIDE it, so the incident
+    # timeline row carries the save's trace id (trace_assemble /
+    # report.py join). Falls through to the plain tracer when
+    # distributed tracing is off, preserving the legacy ckpt.save span.
+    with DISTTRACE.span("ckpt.save", cat="ckpt",
+                        args={"round": round_counter}):
+        t0 = time.perf_counter()
+        ok = False
+        try:
+            _save_model(path, structure_sig=structure_sig,
+                        round_counter=round_counter,
+                        epoch_counter=epoch_counter, params=params,
+                        net_state=net_state, opt_state=opt_state,
+                        step_count=step_count, lr_scale=lr_scale)
+            ok = True
+        finally:
+            # histogram recorded on the WRITING thread (covers the
+            # save_async path too); failures still count their duration
+            t1 = time.perf_counter()
+            _H_CKPT.labels("save").observe(t1 - t0)
+            LEDGER.event("ckpt_save", round=round_counter, path=path,
+                         seconds=round(t1 - t0, 4), ok=ok)
 
 
 def _save_model(path: str, *, structure_sig: tuple, round_counter: int,

@@ -106,6 +106,11 @@ class TelemetryConfig:
     field's zero value means OFF; an unconfigured run pays nothing."""
     trace_path: str = ""          # telemetry_trace: Chrome-trace JSON out
     trace_capacity: int = 65536   # telemetry_trace_capacity: span ring
+    # -- distributed tracing (doc/tasks.md "Distributed tracing") ------
+    trace_sample: float = 1.0     # telemetry_trace_sample: root fraction
+    trace_tail_pct: float = 0.0   # telemetry_trace_tail_pct: exemplars
+    trace_tail_window: int = 128  # telemetry_trace_tail_window: history
+    trace_anchor_s: float = 30.0  # telemetry_trace_anchor_s: clock pairs
     sync_interval: int = 8        # telemetry_sync_interval: probe cadence
     port: int = 0                 # telemetry_port: standalone /metrics
     log_path: str = ""            # telemetry_log: JSONL snapshots
@@ -135,6 +140,10 @@ def parse_telemetry_config(cfg: ConfigPairs) -> TelemetryConfig:
     known = {
         "telemetry_trace": ("trace_path", str),
         "telemetry_trace_capacity": ("trace_capacity", int),
+        "telemetry_trace_sample": ("trace_sample", float),
+        "telemetry_trace_tail_pct": ("trace_tail_pct", float),
+        "telemetry_trace_tail_window": ("trace_tail_window", int),
+        "telemetry_trace_anchor_s": ("trace_anchor_s", float),
         "telemetry_sync_interval": ("sync_interval", int),
         "telemetry_port": ("port", int),
         "telemetry_log": ("log_path", str),
@@ -176,6 +185,22 @@ def parse_telemetry_config(cfg: ConfigPairs) -> TelemetryConfig:
         raise ConfigError(
             f"telemetry_sync_interval must be >= 1, got "
             f"{tc.sync_interval}")
+    if not 0.0 <= tc.trace_sample <= 1.0:
+        raise ConfigError(
+            f"telemetry_trace_sample must be in [0, 1], got "
+            f"{tc.trace_sample}")
+    if not 0.0 <= tc.trace_tail_pct < 100.0:
+        raise ConfigError(
+            f"telemetry_trace_tail_pct must be in [0, 100) "
+            f"(0 = keep every sampled trace), got {tc.trace_tail_pct}")
+    if tc.trace_tail_window < 2:
+        raise ConfigError(
+            f"telemetry_trace_tail_window must be >= 2, got "
+            f"{tc.trace_tail_window}")
+    if tc.trace_anchor_s <= 0:
+        raise ConfigError(
+            f"telemetry_trace_anchor_s must be > 0, got "
+            f"{tc.trace_anchor_s}")
     if tc.log_max_kb < 1:
         raise ConfigError(
             f"telemetry_log_max_kb must be >= 1, got {tc.log_max_kb}")

@@ -47,10 +47,17 @@ from ..io.proc import ThreadBufferIterator
 from ..resilience import retry_call
 from ..resilience.failpoints import InjectedFault
 from ..resilience import failpoints
+from ..telemetry.disttrace import DISTTRACE, estimate_offset
 from ..telemetry.ledger import LEDGER
 from ..telemetry.registry import REGISTRY
 from . import assign, pipeline, wire
 from .pipeline import LocalShardSource
+
+
+#: hard cap on one clock-probe handshake, connect included —
+#: best-effort telemetry must not stall the train loop for the full
+#: fetch timeout when a reader is partitioned
+_CLOCK_PROBE_TIMEOUT_S = 0.25
 
 
 class NoReaderAvailable(OSError):
@@ -106,10 +113,11 @@ class DataServiceClient:
             except OSError:
                 pass
 
-    def _request(self, endpoint: str, req: Dict
-                 ) -> Tuple[Dict, Dict]:
+    def _request(self, endpoint: str, req: Dict) -> Tuple[Dict, Dict]:
         """One request/response on (a possibly cached connection to)
-        one endpoint; any failure closes the connection and raises."""
+        one endpoint; any failure closes the connection and raises.
+        The clock probe has its own transport path (bounded timeout,
+        no failpoint) — see ``probe_clock``."""
         failpoints.check("data.fetch", exc=InjectedFault)
         try:
             sock = self._conn(endpoint)
@@ -150,9 +158,31 @@ class DataServiceClient:
               ) -> Tuple[Dict, Optional[DataBatch]]:
         """(header, batch) for one address; batch is None at
         end-of-shard. Raises :class:`NoReaderAvailable` when every
-        endpoint is down (the iterator's degrade trigger)."""
+        endpoint is down (the iterator's degrade trigger).
+
+        With distributed tracing on, the fetch runs inside a
+        ``dataservice.fetch`` span whose context rides the request's
+        ``tp`` field, so the reader's serve/decode spans parent under
+        it and the assembled fleet trace answers "was this data-wait a
+        cold decode in reader pid N, or the wire". One attribute check
+        when tracing is off; an UNSAMPLED trace adds zero wire bytes
+        (current_traceparent returns None)."""
+        if not DISTTRACE.enabled:
+            return self._fetch(epoch, shard, batch, None)
+        with DISTTRACE.span("dataservice.fetch", cat="dataservice",
+                            args={"epoch": int(epoch),
+                                  "shard": int(shard),
+                                  "batch": int(batch)}):
+            return self._fetch(epoch, shard, batch,
+                               DISTTRACE.current_traceparent())
+
+    def _fetch(self, epoch: int, shard: int, batch: int,
+               tp: Optional[str]
+               ) -> Tuple[Dict, Optional[DataBatch]]:
         req = {"op": "fetch", "epoch": int(epoch), "shard": int(shard),
                "batch": int(batch)}
+        if tp:
+            req["tp"] = tp
         owner = self._owners.get(shard, self.endpoints[0])
         last_exc: Optional[BaseException] = None
         for i, ep in enumerate(assign.failover_order(self.live, owner)):
@@ -192,6 +222,40 @@ class DataServiceClient:
     def meta(self, endpoint: str) -> Dict:
         header, _ = self._request_retrying(endpoint, {"op": "meta"})
         return header
+
+    def probe_clock(self, endpoint: str) -> Optional[Tuple[float, float]]:
+        """One wire-handshake clock-offset probe (``clock`` op): NTP-
+        style midpoint estimate of the reader's wall clock vs ours,
+        recorded into the trace dump's ``otherData.clock_offsets`` for
+        tools/trace_assemble.py. Best-effort: a dead endpoint returns
+        None (the fetch ladder owns liveness, not the probe). The
+        handshake runs on its OWN short-lived socket, capped at
+        ``_CLOCK_PROBE_TIMEOUT_S`` end to end: it executes on the
+        train-loop thread at epoch boundaries, so a partitioned reader
+        must not stall batch production for the full fetch timeout —
+        and a busy reader answering late must cost the probe, never
+        the warm cached fetch connection. (A tight cap also means a
+        tighter rtt bound on any probe that does land.) No
+        ``data.fetch`` failpoint here: side traffic must not consume a
+        once-mode fault armed at the fetch path."""
+        cap = min(_CLOCK_PROBE_TIMEOUT_S, self.svc.timeout_ms / 1e3)
+        host, port = self.svc.split_endpoint(endpoint)
+        deadline = time.monotonic() + cap
+        try:
+            with socket.create_connection((host, port),
+                                          timeout=cap) as sock:
+                t0 = time.time()
+                wire.send_request(sock, {"op": "clock"})
+                header, _ = wire.recv_frame(sock, deadline=deadline)
+                t1 = time.time()
+        except OSError:
+            return None
+        wall = header.get("wall")
+        if not isinstance(wall, (int, float)):
+            return None
+        offset, rtt = estimate_offset(t0, float(wall), t1)
+        DISTTRACE.clock_offset(endpoint, offset, rtt)
+        return offset, rtt
 
     def close(self) -> None:
         for ep in list(self._socks):
@@ -255,6 +319,12 @@ class ServiceIterator(DataIter):
                                          self.n_shards)
         self._live = collections.deque(order)
         self._counters = {s: 0 for s in order}
+        # re-probe reader clock offsets once per epoch (trace-assembly
+        # clock alignment; doc/tasks.md "Distributed tracing") — free
+        # when tracing is off, best-effort when a reader is down
+        if DISTTRACE.enabled and self.client is not None:
+            for ep in self.client.live:
+                self.client.probe_clock(ep)
 
     # -- fetch ladder ------------------------------------------------------
     def _degrade(self, why: str) -> None:

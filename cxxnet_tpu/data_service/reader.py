@@ -41,8 +41,10 @@ from typing import Dict, Optional, Tuple
 from ..config import ConfigPairs, DataServiceConfig
 from ..io import stream
 from ..resilience import failpoints
+from ..telemetry.disttrace import DISTTRACE, set_trace_identity
 from ..telemetry.ledger import LEDGER
 from ..telemetry.registry import REGISTRY
+from ..telemetry.trace import TRACER
 from . import assign, wire
 from .pipeline import LocalShardSource
 
@@ -157,7 +159,14 @@ class DataReaderServer:
             if frame is not None:
                 return frame
             t0 = time.perf_counter()
-            batch = self.source.get(epoch, shard, b)
+            # child_span: records under a client's propagated fetch
+            # context only — the readahead thread's opportunistic
+            # decodes must not open a fresh root trace per batch
+            with DISTTRACE.child_span("dataservice.decode",
+                                      cat="dataservice",
+                                      args={"epoch": epoch,
+                                            "shard": shard, "batch": b}):
+                batch = self.source.get(epoch, shard, b)
             self._h_decode.observe(time.perf_counter() - t0)
             if batch is None:
                 frame = _EOS
@@ -197,6 +206,22 @@ class DataReaderServer:
         with self._stats_lock:
             self.errors += 1
 
+    def _serve_fetch(self, addr: Address) -> Tuple[bytes, bool]:
+        """(frame, cache_hit) for one validated fetch address — the
+        cache/decode/readahead/stats core shared by the traced and
+        untraced request paths."""
+        hit = self._cache_get(addr)
+        frame = hit if hit is not None else self._decode(addr)
+        self._readahead_hint(addr)
+        with self._stats_lock:
+            self.served += 1
+            if hit is not None:
+                self.cache_hits += 1
+        self._c_served.inc()
+        if hit is not None:
+            self._c_hits.inc()
+        return frame, hit is not None
+
     def _respond(self, req: Dict) -> bytes:
         op = req.get("op")
         if op == "fetch":
@@ -215,20 +240,36 @@ class DataReaderServer:
                 return wire.pack_error(
                     "injected fault at failpoint 'data.serve'",
                     epoch=addr[0], shard=addr[1], batch=addr[2])
-            hit = self._cache_get(addr)
-            frame = hit if hit is not None else self._decode(addr)
-            self._readahead_hint(addr)
-            with self._stats_lock:
-                self.served += 1
-                if hit is not None:
-                    self.cache_hits += 1
-            self._c_served.inc()
-            if hit is not None:
-                self._c_hits.inc()
+            # cross-process tracing: a request carrying a sampled ``tp``
+            # context parents this reader's serve/decode spans under the
+            # trainer's fetch span, so the assembled fleet trace shows
+            # WHOSE process a slow fetch spent its time in
+            ctx = (DISTTRACE.extract(req.get("tp"))
+                   if DISTTRACE.enabled else None)
+            if ctx is None:
+                frame, _hit = self._serve_fetch(addr)
+            else:
+                with DISTTRACE.span("dataservice.serve",
+                                    cat="dataservice", parent=ctx,
+                                    args={"epoch": addr[0],
+                                          "shard": addr[1],
+                                          "batch": addr[2],
+                                          "reader": self.index}) as sp:
+                    frame, hit = self._serve_fetch(addr)
+                    sp_args = getattr(sp, "args", None)
+                    if sp_args is not None:
+                        sp_args["cache_hit"] = hit
             if frame is _EOS:
                 return wire.pack_eos(epoch=addr[0], shard=addr[1],
                                      batch=addr[2])
             return frame
+        if op == "clock":
+            # wire-handshake clock-offset probe (client.probe_clock):
+            # our wall clock, bracketed by the client's send/receive
+            # times — the NTP-style midpoint estimate feeds the trace
+            # assembler's cross-host timestamp correction
+            return wire.pack_frame(dict(
+                status="ok", wall=time.time(), reader=self.index))
         if op == "stats":
             with self._stats_lock:
                 return wire.pack_frame(dict(
@@ -274,6 +315,13 @@ class DataReaderServer:
 
         self._server = _Server((host, port), _Handler)
         self.port = self._server.server_address[1]
+        if TRACER.enabled:
+            # name this process's track in the assembled fleet trace
+            # and let the assembler match clients' clock-offset probes
+            # (keyed by the CONFIGURED endpoint, the name clients use)
+            # to this dump
+            set_trace_identity(role="data_reader", reader=self.index,
+                               service_endpoint=self.endpoint)
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True,
             name=f"ds-reader-{self.index}")

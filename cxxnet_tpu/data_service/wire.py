@@ -24,6 +24,7 @@ from __future__ import annotations
 import json
 import socket
 import struct
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -128,13 +129,24 @@ def batch_from(header: Dict[str, Any],
         raise WireError(f"malformed batch frame: {e}")
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
+def _recv_exact(sock: socket.socket, n: int,
+                deadline: Optional[float] = None) -> bytes:
     """Read exactly ``n`` bytes or raise (a short read mid-frame is a
-    torn response, never a valid end)."""
+    torn response, never a valid end). ``deadline`` (a
+    ``time.monotonic`` instant) bounds the WHOLE read: the per-op
+    socket timeout alone restarts on every trickled chunk, so a peer
+    feeding one byte per interval could stall a "bounded" caller
+    indefinitely (the clock probe's contract is end-to-end)."""
     buf = bytearray(n)
     view = memoryview(buf)
     got = 0
     while got < n:
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise socket.timeout(
+                    f"frame read deadline exceeded ({got}/{n} bytes)")
+            sock.settimeout(remaining)
         k = sock.recv_into(view[got:], n - got)
         if k == 0:
             raise WireError(
@@ -143,16 +155,18 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def recv_frame(sock: socket.socket
+def recv_frame(sock: socket.socket,
+               deadline: Optional[float] = None
                ) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
     """Read one response frame -> (header, {name: array})."""
-    magic, hlen = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    magic, hlen = _HDR.unpack(_recv_exact(sock, _HDR.size, deadline))
     if magic != MAGIC:
         raise WireError(f"bad frame magic 0x{magic:08x}")
     if hlen > MAX_FRAME_BYTES:
         raise WireError(f"frame header length {hlen} exceeds bound")
     try:
-        header = json.loads(_recv_exact(sock, hlen).decode("utf-8"))
+        header = json.loads(
+            _recv_exact(sock, hlen, deadline).decode("utf-8"))
     except ValueError as e:
         raise WireError(f"unparseable frame header: {e}")
     arrays: Dict[str, np.ndarray] = {}
@@ -170,7 +184,7 @@ def recv_frame(sock: socket.socket
         total += nbytes
         if total > MAX_FRAME_BYTES:
             raise WireError("frame payloads exceed size bound")
-        raw = _recv_exact(sock, nbytes)
+        raw = _recv_exact(sock, nbytes, deadline)
         arrays[name] = np.frombuffer(raw, dtype).reshape(shape)
     return header, arrays
 

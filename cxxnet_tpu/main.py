@@ -27,8 +27,9 @@ from .graph import global_param
 from .io.data import DataBatch, close_chain, create_iterator
 from .resilience import SentinelAbort, TrainingSentinel, counters, failpoints
 from .telemetry import TelemetrySession
+from .telemetry.disttrace import DISTTRACE, set_trace_identity
 from .telemetry.ledger import LEDGER, config_hash
-from .telemetry.trace import TRACER
+from .telemetry.trace import NULL_SPAN, TRACER
 from .trainer import Trainer
 from . import checkpoint as ckpt
 
@@ -246,6 +247,11 @@ class LearnTask:
         self.telemetry = TelemetrySession(
             self.telemetry_cfg, silent=bool(self.silent),
             cfg_hash=config_hash(self.cfg), host=self._tel_host)
+        if self.telemetry_cfg.trace_path:
+            # name this process's track in tools/trace_assemble.py's
+            # merged fleet trace (the reader refines this with its
+            # service endpoint when it binds)
+            set_trace_identity(role=self.task)
         # persistent compile cache BEFORE the first executable builds
         # (train step fns, serve buckets): warm restarts — elastic
         # takeovers, replica cold-starts, continue=1 — deserialize
@@ -897,6 +903,18 @@ class LearnTask:
                 "train_chain composes with dp/tp/sp, train metrics, "
                 "and (std-mode) update_period accumulation — but not "
                 "with pp, nor with accumulation under sp")
+        # per-step ROOT span for distributed tracing: h2d/dispatch spans
+        # and the probe's device_block sync nest under it, ledger events
+        # emitted inside it carry its trace id, and tail-exemplar mode
+        # retains only the slowest steps' trees. The disabled path is
+        # one attribute check + the shared no-op span — never a fresh
+        # context manager per step.
+        def step_span(round_no: int, steps: int = 1):
+            if not DISTTRACE.enabled:
+                return NULL_SPAN
+            return DISTTRACE.span("train.step", cat="train",
+                                  args={"round": round_no,
+                                        "steps": steps})
         for r in range(self.start_counter, end_round):
             tr.start_round(r)
             self._cur_round = r      # the grace checkpoint's round label
@@ -938,11 +956,12 @@ class LearnTask:
                     if profiler is not None:
                         profiler.maybe_start(tr._step_count)
                     t_d = time.perf_counter()
-                    losses = tr.update_chain_batches(pending)
-                    if probe is not None:
-                        probe.record_step(time.perf_counter() - t_d,
-                                          ready=losses,
-                                          steps=len(pending))
+                    with step_span(r, steps=len(pending)):
+                        losses = tr.update_chain_batches(pending)
+                        if probe is not None:
+                            probe.record_step(time.perf_counter() - t_d,
+                                              ready=losses,
+                                              steps=len(pending))
                     if profiler is not None:
                         profiler.maybe_stop(tr._step_count, ready=losses)
                     batch_count += len(pending)
@@ -953,10 +972,12 @@ class LearnTask:
                     if profiler is not None:
                         profiler.maybe_start(tr._step_count)
                     t_d = time.perf_counter()
-                    tr.update(batch)
-                    if probe is not None:
-                        probe.record_step(time.perf_counter() - t_d,
-                                          ready=tr.last_loss_handle)
+                    with step_span(r):
+                        tr.update(batch)
+                        if probe is not None:
+                            probe.record_step(
+                                time.perf_counter() - t_d,
+                                ready=tr.last_loss_handle)
                     if profiler is not None:
                         profiler.maybe_stop(tr._step_count,
                                             ready=tr.last_loss_handle)
@@ -974,10 +995,11 @@ class LearnTask:
                           f"{ips:.1f} images/sec", flush=True)
             for b in pending:      # epoch tail shorter than the chain
                 t_d = time.perf_counter()
-                tr.update(b)
-                if probe is not None:
-                    probe.record_step(time.perf_counter() - t_d,
-                                      ready=tr.last_loss_handle)
+                with step_span(r):
+                    tr.update(b)
+                    if probe is not None:
+                        probe.record_step(time.perf_counter() - t_d,
+                                          ready=tr.last_loss_handle)
                 n_images += b.batch_size - b.num_batch_padd
                 batch_count += 1
                 self._sentinel_step(tr, r)

@@ -34,6 +34,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..resilience import CircuitBreaker, CircuitOpen
+from ..telemetry.disttrace import DISTTRACE
 from ..telemetry.registry import REGISTRY
 from ..telemetry.trace import TRACER
 from .engine import InferenceEngine
@@ -49,7 +50,8 @@ class DeadlineExceeded(TimeoutError):
 
 
 class _Request:
-    __slots__ = ("rows", "kind", "node", "future", "t_submit", "deadline")
+    __slots__ = ("rows", "kind", "node", "future", "t_submit", "deadline",
+                 "ctx")
 
     def __init__(self, rows, kind, node, deadline):
         self.rows = rows
@@ -58,6 +60,11 @@ class _Request:
         self.future: Future = Future()
         self.t_submit = time.perf_counter()
         self.deadline = deadline          # perf_counter abs, or None
+        # distributed-trace context of the submitting thread (the HTTP
+        # handler's serve.request span): the worker attributes this
+        # request's queue-wait / batch-assembly / infer segments to it
+        # across the thread hop. None (one attr check) when tracing off.
+        self.ctx = DISTTRACE.current()
 
 
 class MicroBatcher:
@@ -221,6 +228,7 @@ class MicroBatcher:
                          args={"requests": len(live)}):
             rows = (live[0].rows if len(live) == 1
                     else np.concatenate([r.rows for r in live], axis=0))
+        t_asm1 = time.perf_counter()
         try:
             out = self.engine.run_padded(rows, live[0].kind, live[0].node)
         except Exception as e:
@@ -230,8 +238,26 @@ class MicroBatcher:
                 self.stats.record_failure()
                 r.future.set_exception(e)
             return
+        t_infer1 = time.perf_counter()
         if self.breaker is not None:
             self.breaker.record_success()
+        if DISTTRACE.enabled:
+            # per-request critical-path attribution, parented across the
+            # thread hop onto each request's serve.request span: queue
+            # wait (submit -> dispatch), batch assembly, infer. A batch
+            # shares the assembly/infer wall time — each member sees the
+            # full segment, which is exactly what its request paid.
+            for r in live:
+                if r.ctx is not None:
+                    DISTTRACE.record("serve.queue_wait", r.t_submit,
+                                     t_now, r.ctx, cat="serve",
+                                     args={"requests": len(live)})
+                    DISTTRACE.record("serve.batch_assembly", t_now,
+                                     t_asm1, r.ctx, cat="serve",
+                                     args={"requests": len(live)})
+                    DISTTRACE.record("serve.infer", t_asm1, t_infer1,
+                                     r.ctx, cat="serve",
+                                     args={"rows": int(rows.shape[0])})
         self.stats.record_batch(
             n_requests=len(live), rows_real=rows.shape[0],
             rows_bucket=self.engine.bucket_for(rows.shape[0]))

@@ -42,6 +42,7 @@ import time
 from typing import Any, Dict, List, Optional, Sequence
 
 from ..resilience import CircuitBreaker
+from ..telemetry.disttrace import DISTTRACE
 from ..telemetry.ledger import LEDGER
 from ..telemetry.registry import REGISTRY
 from ..telemetry.slo import SLOTracker
@@ -376,7 +377,16 @@ class ReplicaPool:
         swapping its version) between pick() and submit would otherwise
         serve a version-pinned request from the wrong model. The
         per-version outcome accounting hangs off the future so A/B
-        comparisons see terminal results, not admissions."""
+        comparisons see terminal results, not admissions.
+
+        With distributed tracing on, the route decision lands as a
+        ``serve.route`` child span on the request's trace naming the
+        replica that won — the assembled fleet trace answers "which
+        replica served this slow request" without cross-referencing
+        stats. One attribute check (``current`` is None) when off."""
+        route_ctx = DISTTRACE.current()
+        t_route0 = time.perf_counter() if route_ctx is not None else 0.0
+        t_route1 = t_route0
         for _ in range(8):            # re-pick bound: reloads are rare
             rep = self.pick(version)
             with rep.admission_lock:
@@ -384,6 +394,11 @@ class ReplicaPool:
                                        and rep.version != version):
                     continue          # lost a race with a reload
                 ver = rep.version
+                # route ends BEFORE the enqueue: queue_wait starts
+                # inside submit(), and the critical-path report sums
+                # the two as disjoint segments of the request e2e
+                if route_ctx is not None:
+                    t_route1 = time.perf_counter()
                 fut = rep.batcher.submit(data, kind, node,
                                          timeout_ms=timeout_ms)
                 break
@@ -391,6 +406,10 @@ class ReplicaPool:
             raise NoHealthyReplica(
                 "could not admit request: replicas kept transitioning "
                 "(reload storm?) — retry later")
+        if route_ctx is not None:
+            DISTTRACE.record("serve.route", t_route0, t_route1,
+                             route_ctx, cat="serve",
+                             args={"replica": rep.idx, "version": ver})
         t0 = time.perf_counter()
         with self._lock:
             vs = self._vstats.setdefault(

@@ -59,6 +59,7 @@ import numpy as np
 
 from ..resilience import CircuitBreaker, CircuitOpen, counters
 from ..telemetry import PROMETHEUS_CONTENT_TYPE, render_prometheus
+from ..telemetry.disttrace import DISTTRACE
 from ..telemetry.ledger import LEDGER, run_info
 from ..telemetry.slo import SLOTracker
 from ..telemetry.trace import TRACER
@@ -119,9 +120,18 @@ def _make_handler(server: "ServeServer"):
                 self._reply(404, {"error": f"no such path {self.path}"})
                 return
             # full request-lifecycle span (parse -> queue -> infer ->
-            # respond nest inside it on this handler thread's track)
-            with TRACER.span("serve.request", cat="serve",
-                             args={"path": self.path}):
+            # respond nest inside it on this handler thread's track).
+            # An incoming W3C ``traceparent`` header (tools/loadgen.py
+            # sends one per request when tracing) parents this span
+            # under the CLIENT's span, so the assembled fleet trace
+            # links loadgen -> router -> queue -> infer -> respond
+            # end-to-end; without the header this is a new root trace.
+            # Falls back to the plain TRACER span when distributed
+            # tracing is off.
+            ctx = (DISTTRACE.extract(self.headers.get("traceparent"))
+                   if DISTTRACE.enabled else None)
+            with DISTTRACE.span("serve.request", cat="serve",
+                                args={"path": self.path}, parent=ctx):
                 self._handle_post()
 
         def _handle_post(self):

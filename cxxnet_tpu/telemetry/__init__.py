@@ -29,6 +29,9 @@ from typing import Optional
 
 from .registry import REGISTRY, MetricRegistry, get_registry, log_buckets
 from .trace import TRACER, Tracer, get_tracer
+from .disttrace import (DISTTRACE, DistTracer, TraceContext,
+                        estimate_offset, get_disttracer,
+                        parse_traceparent, set_trace_identity)
 from .steptime import StepTimeProbe
 from .exporter import (PROMETHEUS_CONTENT_TYPE, MetricsServer,
                        TelemetryLogger, render_prometheus)
@@ -45,6 +48,8 @@ from .slo import SLOTracker
 __all__ = [
     "REGISTRY", "MetricRegistry", "get_registry", "log_buckets",
     "TRACER", "Tracer", "get_tracer",
+    "DISTTRACE", "DistTracer", "TraceContext", "estimate_offset",
+    "get_disttracer", "parse_traceparent", "set_trace_identity",
     "StepTimeProbe", "StepProfiler",
     "MetricsServer", "TelemetryLogger", "render_prometheus",
     "PROMETHEUS_CONTENT_TYPE", "TelemetrySession",
@@ -97,6 +102,15 @@ class TelemetrySession:
                 threshold=cfg.storm_threshold)
         if cfg.trace_path:
             TRACER.enable(capacity=cfg.trace_capacity)
+            # the distributed layer rides the same knob: cross-process
+            # context propagation, legacy-span stamping, tail-exemplar
+            # retention and clock anchors (doc/tasks.md "Distributed
+            # tracing")
+            DISTTRACE.enable(sample=cfg.trace_sample,
+                             tail_pct=cfg.trace_tail_pct,
+                             tail_window=cfg.trace_tail_window,
+                             anchor_s=cfg.trace_anchor_s)
+            set_trace_identity(host=self.host)
         if cfg.log_path:
             self.logger = TelemetryLogger(
                 cfg.log_path, interval_s=cfg.log_interval_s,
@@ -197,6 +211,8 @@ class TelemetrySession:
         if self.logger is not None:
             self.logger.stop()
         if self.cfg.trace_path:
+            # final wall-clock anchor so the very last spans are dated
+            DISTTRACE.anchor(force=True)
             n = TRACER.dump(self.cfg.trace_path)
             if not self.silent:
                 print(f"telemetry: {n} trace events -> "

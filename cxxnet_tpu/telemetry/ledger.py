@@ -45,6 +45,7 @@ import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
+from .disttrace import DISTTRACE
 from .registry import REGISTRY
 
 LEDGER_SCHEMA = 1
@@ -110,6 +111,15 @@ class RunLedger:
         # line, when, for which run) must never be clobberable by an
         # event payload that happens to use the same key
         rec: Dict[str, Any] = dict(fields)
+        # join the incident timeline with distributed traces: an event
+        # emitted while a sampled span is current (a ckpt_save inside
+        # its save span, a dataservice_degrade inside the fetch that
+        # hit it) carries the trace id so tools/report.py and
+        # tools/trace_assemble.py can cross-reference
+        if "trace_id" not in rec:
+            tid = DISTTRACE.current_trace_id()
+            if tid:
+                rec["trace_id"] = tid
         rec.update({
             "schema": LEDGER_SCHEMA,
             "ts": round(time.time(), 3),

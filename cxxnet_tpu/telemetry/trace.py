@@ -28,12 +28,15 @@ thread render as a flame stack and concurrent threads as parallel tracks
 
 from __future__ import annotations
 
+import copy
 import json
 import os
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
+
+from .registry import REGISTRY
 
 
 class _NullSpan:
@@ -48,6 +51,12 @@ class _NullSpan:
 
 
 _NULL_SPAN = _NullSpan()
+
+#: shared no-op context manager, importable by hot paths that gate on
+#: ``TRACER.enabled``/``DISTTRACE.enabled`` themselves (a fresh
+#: ``contextlib.nullcontext()`` per step would be an allocation the
+#: disabled-tracing contract forbids)
+NULL_SPAN = _NULL_SPAN
 
 
 class _Span:
@@ -84,6 +93,23 @@ class Tracer:
         self._t0 = time.perf_counter()
         self.dropped = 0
         self._thread_names: Dict[int, str] = {}
+        # optional event sink (telemetry.disttrace): called with each
+        # event BEFORE it reaches the ring — it may stamp distributed-
+        # trace ids into args and/or consume the event into a
+        # tail-exemplar buffer (return True = consumed). None when
+        # distributed tracing is off, so the base tracer pays nothing.
+        self._sink: Optional[Callable[[Dict[str, Any]], bool]] = None
+        # extra keys merged into the dump's otherData — clock anchors,
+        # wire clock-offset probes, process identity (disttrace owns
+        # the content; the tracer only carries it into the export)
+        self.extra_other: Dict[str, Any] = {}
+        # ring-overflow drops as a registry counter: the dump's
+        # otherData.dropped_events is only visible post-mortem, but a
+        # week-long run's silent span loss must show on /metrics and in
+        # tools/report.py while the run is still alive
+        self._c_dropped = REGISTRY.counter(
+            "cxxnet_trace_dropped_total",
+            "Trace events dropped on span-ring overflow")
 
     # -- lifecycle -------------------------------------------------------
     @property
@@ -105,6 +131,14 @@ class Tracer:
             self._thread_names.clear()
             self.dropped = 0
             self._t0 = time.perf_counter()
+            self.extra_other = {}
+
+    def to_ts_us(self, perf_s: float) -> float:
+        """Map a ``time.perf_counter()`` value onto this tracer's event
+        timescale (microseconds since the ring's epoch) — the same
+        coordinate every exported ``ts`` uses, so clock anchors recorded
+        in it line up with the events they date."""
+        return (perf_s - self._t0) * 1e6
 
     # -- recording -------------------------------------------------------
     def span(self, name: str, cat: str = "",
@@ -158,13 +192,37 @@ class Tracer:
         self._push(ev)
 
     def _push(self, ev: Dict[str, Any]) -> None:
+        sink = self._sink
+        if sink is not None and sink(ev):
+            return
+        self._push_raw(ev)
+
+    def set_sink(self, sink: Optional[Callable[[Dict[str, Any]], bool]]
+                 ) -> None:
+        """Install (or clear) the distributed-trace event sink — see
+        ``_push``. One sink at a time; telemetry.disttrace owns it."""
+        self._sink = sink
+
+    def push_event(self, ev: Dict[str, Any]) -> None:
+        """Append one pre-built Chrome event, bypassing the sink — the
+        distributed layer uses this to flush events it already stamped
+        (and possibly buffered), so they cannot re-enter the sink."""
+        if not self._enabled:
+            return
+        self._push_raw(ev)
+
+    def _push_raw(self, ev: Dict[str, Any]) -> None:
         t = threading.current_thread()
+        overflow = False
         with self._lock:
             if t.ident is not None and t.ident not in self._thread_names:
                 self._thread_names[t.ident] = t.name
             if len(self._buf) == self._buf.maxlen:
                 self.dropped += 1
+                overflow = True
             self._buf.append(ev)
+        if overflow:
+            self._c_dropped.inc()
 
     # -- reading / export ------------------------------------------------
     def events(self) -> List[Dict[str, Any]]:
@@ -179,14 +237,22 @@ class Tracer:
             events = list(self._buf)
             names = dict(self._thread_names)
             dropped = self.dropped
+            # deep copy: a shallow dict() would share the nested
+            # clock_anchors list / clock_offsets dict, which background
+            # threads closing root spans keep mutating while json.dumps
+            # below runs outside the lock
+            extra = copy.deepcopy(self.extra_other)
         meta = [{"name": "thread_name", "ph": "M", "pid": os.getpid(),
                  "tid": tid, "args": {"name": name}}
                 for tid, name in sorted(names.items())]
+        other = {"dropped_events": dropped,
+                 "producer": "cxxnet_tpu.telemetry",
+                 "pid": os.getpid()}
+        other.update(extra)
         doc = {
             "traceEvents": meta + events,
             "displayTimeUnit": "ms",
-            "otherData": {"dropped_events": dropped,
-                          "producer": "cxxnet_tpu.telemetry"},
+            "otherData": other,
         }
         from ..io import stream
         payload = json.dumps(doc).encode("utf-8")

@@ -314,6 +314,38 @@ def test_wire_rejects_bad_magic():
         b.close()
 
 
+@pytest.mark.quick
+def test_wire_recv_deadline_bounds_trickled_reads():
+    # a peer feeding one byte per interval restarts a naive per-op
+    # socket timeout on every chunk; the deadline form (the clock
+    # probe's end-to-end cap) must abort regardless of trickle cadence
+    import threading
+    a, b = socket.socketpair()
+    stop = threading.Event()
+
+    def trickle():
+        while not stop.is_set():
+            try:
+                a.sendall(b"x")
+            except OSError:
+                return
+            time.sleep(0.03)
+
+    th = threading.Thread(target=trickle, daemon=True)
+    th.start()
+    t0 = time.monotonic()
+    try:
+        with pytest.raises(socket.timeout):
+            wire._recv_exact(b, 10_000,
+                             deadline=time.monotonic() + 0.15)
+        assert time.monotonic() - t0 < 1.0
+    finally:
+        stop.set()
+        a.close()
+        b.close()
+        th.join(timeout=2.0)
+
+
 # -- reader + client ----------------------------------------------------------
 
 @pytest.mark.quick

@@ -1,0 +1,648 @@
+"""Distributed tracing: context propagation, clock alignment, assembly.
+
+Covers the PR-14 acceptance surfaces (doc/tasks.md "Distributed
+tracing"):
+
+* trace-context (W3C traceparent) encode/decode round-trip and the
+  malformed-header "no context, never an error" rule;
+* cross-process parenting over a REAL socketpair to a child
+  interpreter — the child's span carries the parent's trace id, the
+  parent span id, and the child's pid;
+* clock-offset property test: NTP-style midpoint estimation recovers
+  an injected skew within rtt/2, for any asymmetric delays;
+* tail-exemplar retention: the slowest k% of root spans keep their
+  tree, the rest degrade to counters;
+* the overhead contract: tracing disabled is one attribute check
+  returning shared singletons (no allocations on the hot path), and an
+  UNSAMPLED trace adds zero wire-header bytes;
+* SpanTracer overflow drops export as ``cxxnet_trace_dropped_total``
+  (the satellite bugfix: /metrics must show span loss while the run is
+  alive, not only the dump's otherData post-mortem);
+* tools/trace_assemble.py: offset-corrected merge, flow links,
+  chain-violation detection, train/serve critical-path attribution.
+"""
+
+import gc
+import json
+import os
+import random
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from cxxnet_tpu.telemetry import disttrace as dt_mod
+from cxxnet_tpu.telemetry.disttrace import (DISTTRACE, TraceContext,
+                                            estimate_offset,
+                                            parse_traceparent,
+                                            set_trace_identity)
+from cxxnet_tpu.telemetry.ledger import LEDGER
+from cxxnet_tpu.telemetry.registry import REGISTRY
+from cxxnet_tpu.telemetry.trace import NULL_SPAN, TRACER, Tracer
+
+import trace_assemble as ta
+
+
+@pytest.fixture
+def dist(request):
+    """Enabled TRACER + DISTTRACE, cleaned up whatever happens."""
+    TRACER.enable(capacity=4096)
+    TRACER.clear()
+    DISTTRACE.enable()
+    yield DISTTRACE
+    DISTTRACE.disable()
+    TRACER.disable()
+    TRACER.clear()
+
+
+def _events(name=None):
+    evs = TRACER.events()
+    return [e for e in evs if name is None or e.get("name") == name]
+
+
+# -- context encode/decode ---------------------------------------------------
+
+def test_traceparent_roundtrip():
+    ctx = TraceContext(os.urandom(16).hex(), os.urandom(8).hex(), True)
+    back = parse_traceparent(ctx.traceparent())
+    assert (back.trace_id, back.span_id, back.sampled) == \
+        (ctx.trace_id, ctx.span_id, True)
+    unsampled = TraceContext(ctx.trace_id, ctx.span_id, False)
+    back2 = parse_traceparent(unsampled.traceparent())
+    assert back2.sampled is False and back2.trace_id == ctx.trace_id
+
+
+@pytest.mark.parametrize("bad", [
+    None, "", "garbage", "00-short-beef-01",
+    "01-" + "a" * 32 + "-" + "b" * 16 + "-01",     # unknown version
+    "00-" + "0" * 32 + "-" + "b" * 16 + "-01",     # all-zero trace id
+    "00-" + "a" * 32 + "-" + "0" * 16 + "-01",     # all-zero span id
+    "00-" + "g" * 32 + "-" + "b" * 16 + "-01",     # non-hex
+    "00-" + "a" * 32 + "-" + "b" * 16 + "-zz",     # non-hex flags
+    "00-" + "a" * 32 + "-" + "b" * 16,             # missing flags
+])
+def test_traceparent_malformed_is_no_context(bad):
+    # an unparseable header means "no context", never an error —
+    # tracing must not reject traffic
+    assert parse_traceparent(bad) is None
+
+
+def test_child_context_inherits_trace_and_flags():
+    root = TraceContext("ab" * 16, "cd" * 8, True)
+    child = root.child("ef" * 8)
+    assert child.trace_id == root.trace_id
+    assert child.span_id != root.span_id and child.sampled
+
+
+# -- span recording ----------------------------------------------------------
+
+def test_root_and_child_span_ids_recorded(dist):
+    with DISTTRACE.span("outer", cat="t") as outer:
+        with DISTTRACE.span("inner") as inner:
+            pass
+    out = _events("outer")[0]
+    inn = _events("inner")[0]
+    assert out["args"]["span_id"] == outer.ctx.span_id
+    assert "parent_span_id" not in out["args"]
+    assert inn["args"]["trace_id"] == out["args"]["trace_id"]
+    assert inn["args"]["parent_span_id"] == outer.ctx.span_id
+
+
+def test_legacy_tracer_spans_join_the_tree_via_sink(dist):
+    # existing TRACER instrumentation points (train.h2d_stage,
+    # serve.respond, ...) are stamped with the current distributed
+    # context without being rewritten
+    with DISTTRACE.span("root") as sp:
+        with TRACER.span("legacy.child", cat="x"):
+            pass
+    ev = _events("legacy.child")[0]
+    assert ev["args"]["trace_id"] == sp.ctx.trace_id
+    assert ev["args"]["parent_span_id"] == sp.ctx.span_id
+    # outside any distributed span, legacy events pass through unstamped
+    with TRACER.span("legacy.alone"):
+        pass
+    assert "trace_id" not in (_events("legacy.alone")[0].get("args")
+                              or {})
+
+
+def test_record_explicit_interval_parents_across_threads(dist):
+    # the batcher's queue-wait attribution: durations measured on the
+    # worker thread, parented onto the submitting thread's span
+    with DISTTRACE.span("request") as sp:
+        parent = DISTTRACE.current()
+    sid = DISTTRACE.record("queue_wait", 1.0, 2.0, parent, cat="serve")
+    ev = _events("queue_wait")[0]
+    assert ev["args"]["span_id"] == sid
+    assert ev["args"]["parent_span_id"] == sp.ctx.span_id
+    assert ev["dur"] == pytest.approx(1e6)
+
+
+def test_deterministic_sampling_agrees_across_processes(dist):
+    # the sampling decision is a pure function of the trace id, so any
+    # process deriving it from a propagated context agrees with the
+    # originator
+    DISTTRACE.sample = 0.5
+    ids = [dt_mod.new_trace_id() for _ in range(64)]
+    first = [DISTTRACE._sampled(t) for t in ids]
+    assert first == [DISTTRACE._sampled(t) for t in ids]
+    assert any(first) and not all(first)     # 2^-64 flake odds
+
+
+# -- the overhead contract ---------------------------------------------------
+
+def test_disabled_is_shared_noop_and_none():
+    assert not DISTTRACE.enabled and not TRACER.enabled
+    assert DISTTRACE.span("x") is DISTTRACE.span("y") is NULL_SPAN
+    assert DISTTRACE.child_span("x") is NULL_SPAN
+    assert DISTTRACE.current() is None
+    assert DISTTRACE.current_traceparent() is None
+    assert DISTTRACE.current_trace_id() is None
+    assert DISTTRACE.extract("00-" + "a" * 32 + "-" + "b" * 16 + "-01") \
+        is None
+
+
+def test_disabled_hot_path_allocates_nothing():
+    # the "disabled = one attr check" contract, pinned: the span /
+    # context entry points return shared singletons — N calls leave the
+    # allocated-block count flat (modulo unrelated interpreter noise)
+    assert not DISTTRACE.enabled
+    for _ in range(64):          # warm any caches
+        DISTTRACE.span("s")
+        DISTTRACE.current_traceparent()
+    gc.collect()
+    b0 = sys.getallocatedblocks()
+    for _ in range(4096):
+        DISTTRACE.span("s")
+        DISTTRACE.child_span("s")
+        DISTTRACE.current()
+        DISTTRACE.current_traceparent()
+    gc.collect()
+    assert sys.getallocatedblocks() - b0 < 64
+
+
+def test_unsampled_trace_adds_zero_wire_bytes(dist):
+    DISTTRACE.sample = 0.0
+    with DISTTRACE.span("dataservice.fetch"):
+        # the wire carrier is only attached for sampled contexts: the
+        # request dict (and so its JSON line) is byte-identical to the
+        # tracing-off request
+        assert DISTTRACE.current_traceparent() is None
+        req = {"op": "fetch", "epoch": 0, "shard": 0, "batch": 0}
+        tp = DISTTRACE.current_traceparent()
+        if tp:
+            req["tp"] = tp
+        baseline = {"op": "fetch", "epoch": 0, "shard": 0, "batch": 0}
+        assert json.dumps(req) == json.dumps(baseline)
+        # descendants inherit the unsampled flag instead of opening a
+        # fresh sampled root mid-request
+        with DISTTRACE.span("dataservice.decode"):
+            assert DISTTRACE.current_traceparent() is None
+    assert _events() == []       # nothing recorded for unsampled
+
+
+# -- clock alignment ---------------------------------------------------------
+
+def test_estimate_offset_recovers_injected_skew_within_rtt():
+    rng = random.Random(7)
+    for _ in range(300):
+        skew = rng.uniform(-10.0, 10.0)        # server clock - ours
+        d_req = rng.uniform(0.0, 0.050)        # asymmetric delays
+        d_resp = rng.uniform(0.0, 0.050)
+        t0 = rng.uniform(0.0, 1e6)
+        server_wall = t0 + d_req + skew        # server reads its clock
+        t1 = t0 + d_req + d_resp
+        offset, rtt = estimate_offset(t0, server_wall, t1)
+        assert rtt == pytest.approx(d_req + d_resp)
+        assert abs(offset - skew) <= rtt / 2.0 + 1e-9
+
+
+def test_anchors_and_offsets_land_in_dump_other_data(dist, tmp_path):
+    DISTTRACE.anchor(force=True)
+    DISTTRACE.clock_offset("10.0.0.2:9400", 1.25, 0.004)
+    set_trace_identity(role="train", host=3)
+    path = str(tmp_path / "t.json")
+    with DISTTRACE.span("s"):
+        pass
+    TRACER.dump(path)
+    other = json.load(open(path))["otherData"]
+    anchors = other["clock_anchors"]
+    assert anchors and {"ts_us", "wall"} <= set(anchors[0])
+    assert other["clock_offsets"]["10.0.0.2:9400"]["offset_s"] == 1.25
+    assert other["role"] == "train" and other["host"] == 3
+    assert other["pid"] == os.getpid()
+
+
+def test_anchor_list_is_bounded(dist):
+    for _ in range(dt_mod._MAX_ANCHORS * 2):
+        DISTTRACE._last_anchor = 0.0         # defeat the rate limiter
+        DISTTRACE.anchor(force=True)
+    with TRACER._lock:
+        n = len(TRACER.extra_other["clock_anchors"])
+    assert n <= dt_mod._MAX_ANCHORS
+
+
+# -- tail-exemplar retention -------------------------------------------------
+
+class _FakeTime:
+    """Controllable stand-in for the ``time`` module inside disttrace:
+    span durations become exact, so the tail threshold is deterministic."""
+
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def perf_counter(self):
+        return self.t
+
+    def time(self):
+        return 1.7e9 + self.t
+
+
+def test_tail_exemplar_keeps_slowest_pct(monkeypatch):
+    TRACER.enable(capacity=4096)
+    TRACER.clear()
+    DISTTRACE.enable(tail_pct=10.0, tail_window=64)
+    fake = _FakeTime()
+    monkeypatch.setattr(dt_mod, "time", fake)
+    try:
+        def root(dur_s, child=True):
+            with DISTTRACE.span("step"):
+                if child:
+                    with DISTTRACE.span("step.child"):
+                        fake.t += dur_s
+        for _ in range(20):                   # build the history window
+            root(0.010)
+        dropped0 = REGISTRY.counter(
+            "cxxnet_trace_tail_dropped_total").value
+        n0 = len(_events("step"))
+        root(0.100)                           # slowest so far: kept
+        kept = _events("step")
+        assert len(kept) == n0 + 1
+        assert kept[-1]["dur"] == pytest.approx(1e5)
+        # ... with its WHOLE subtree
+        assert any(e["dur"] == pytest.approx(1e5)
+                   for e in _events("step.child"))
+        n1 = len(_events("step"))
+        root(0.001)                           # fast root: tree dropped
+        assert len(_events("step")) == n1
+        d = REGISTRY.counter("cxxnet_trace_tail_dropped_total").value
+        assert d >= dropped0 + 2              # root + buffered child
+    finally:
+        DISTTRACE.disable()
+        TRACER.disable()
+        TRACER.clear()
+
+
+def test_tail_buffer_closed_late_children_follow_root_fate(monkeypatch):
+    # the batcher finishing a request whose HTTP handler already timed
+    # out (i.e. precisely the slowest requests): record() against a
+    # root that already closed its tail buffer must follow the root's
+    # keep/drop decision, not vanish into a dead list
+    TRACER.enable(capacity=4096)
+    TRACER.clear()
+    DISTTRACE.enable(tail_pct=10.0, tail_window=64)
+    fake = _FakeTime()
+    monkeypatch.setattr(dt_mod, "time", fake)
+    try:
+        def root(dur_s):
+            with DISTTRACE.span("req") as sp:
+                ctx = sp.ctx
+                fake.t += dur_s
+            return ctx
+        for _ in range(20):                   # build the history window
+            root(0.010)
+        kept_ctx = root(0.100)                # slowest so far: kept
+        assert DISTTRACE.record("late.kept", 1.0, 2.0,
+                                kept_ctx) is not None
+        assert len(_events("late.kept")) == 1   # settled into the ring
+        dropped0 = REGISTRY.counter(
+            "cxxnet_trace_tail_dropped_total").value
+        fast_ctx = root(0.001)                # fast root: tree dropped
+        DISTTRACE.record("late.dropped", 1.0, 2.0, fast_ctx)
+        assert _events("late.dropped") == []
+        d = REGISTRY.counter("cxxnet_trace_tail_dropped_total").value
+        assert d >= dropped0 + 2              # dropped root + late child
+    finally:
+        DISTTRACE.disable()
+        TRACER.disable()
+        TRACER.clear()
+
+
+# -- overflow counter (satellite bugfix) -------------------------------------
+
+def test_ring_overflow_exports_registry_counter():
+    tr = Tracer(capacity=4)
+    tr.enable()
+    before = REGISTRY.counter("cxxnet_trace_dropped_total").value
+    for i in range(10):
+        tr.add_complete(f"e{i}", 0.0, 1.0)
+    assert tr.dropped == 6
+    after = REGISTRY.counter("cxxnet_trace_dropped_total").value
+    assert after - before == 6
+
+
+# -- ledger joins ------------------------------------------------------------
+
+def test_ledger_events_carry_current_trace_id(dist, tmp_path):
+    path = str(tmp_path / "led.jsonl")
+    LEDGER.enable(path, run_id="r-test", host=0)
+    try:
+        with DISTTRACE.span("ckpt.save") as sp:
+            LEDGER.event("ckpt_save", round=3, ok=True)
+        LEDGER.event("round_end", round=3)        # no active span
+        lines = [json.loads(l) for l in open(path)]
+        save = next(e for e in lines if e["event"] == "ckpt_save")
+        rend = next(e for e in lines if e["event"] == "round_end")
+        assert save["trace_id"] == sp.ctx.trace_id
+        assert "trace_id" not in rend
+    finally:
+        LEDGER.disable()
+
+
+# -- cross-process parenting over a real socketpair --------------------------
+
+_CHILD_SRC = r"""
+import json, os, socket, sys, time
+sys.path.insert(0, %r)
+sock = socket.socket(fileno=int(sys.argv[1]))
+f = sock.makefile("rb")
+req = json.loads(f.readline())
+from cxxnet_tpu.telemetry.trace import TRACER
+from cxxnet_tpu.telemetry.disttrace import DISTTRACE
+TRACER.enable()
+DISTTRACE.enable()
+ctx = DISTTRACE.extract(req.get("tp"))
+with DISTTRACE.span("child.decode", cat="dataservice", parent=ctx):
+    time.sleep(0.005)
+sock.sendall((json.dumps({"pid": os.getpid(),
+                          "events": TRACER.events()}) + "\n").encode())
+sock.close()
+""" % (REPO,)
+
+
+def test_cross_process_parenting_over_socketpair(dist):
+    here, there = socket.socketpair()
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _CHILD_SRC, str(there.fileno())],
+            pass_fds=(there.fileno(),), cwd=REPO,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        there.close()
+        with DISTTRACE.span("parent.fetch", cat="dataservice") as sp:
+            tp = DISTTRACE.current_traceparent()
+            here.sendall((json.dumps({"tp": tp}) + "\n").encode())
+            resp = json.loads(here.makefile("rb").readline())
+        assert proc.wait(timeout=120) == 0
+    finally:
+        here.close()
+    assert resp["pid"] != os.getpid()
+    child = next(e for e in resp["events"]
+                 if e["name"] == "child.decode")
+    assert child["pid"] == resp["pid"]
+    assert child["args"]["trace_id"] == sp.ctx.trace_id
+    assert child["args"]["parent_span_id"] == sp.ctx.span_id
+
+
+# -- trace assembly ----------------------------------------------------------
+
+_TID = "ab" * 16
+_SPAN_A = "a1" * 8
+_SPAN_B = "b2" * 8
+
+
+def _trainer_dump(with_probe=True):
+    other = {"pid": 111, "role": "train",
+             "clock_anchors": [{"ts_us": 0.0, "wall": 1000.0}]}
+    if with_probe:
+        other["clock_offsets"] = {"r0": {"offset_s": 3.0,
+                                         "rtt_s": 0.002}}
+    return ta.Dump("trainer.json", {
+        "traceEvents": [
+            {"name": "dataservice.fetch", "ph": "X", "ts": 1000.0,
+             "dur": 5000.0, "pid": 111, "tid": 1,
+             "args": {"trace_id": _TID, "span_id": _SPAN_A}}],
+        "otherData": other})
+
+
+def _reader_dump():
+    # the reader's wall clock runs 3 s AHEAD; its serve span sits at
+    # reader-wall 1003.0025, which is trainer-wall 1000.0025 — inside
+    # the fetch span once the probe's offset is applied
+    return ta.Dump("reader.json", {
+        "traceEvents": [
+            {"name": "dataservice.serve", "ph": "X", "ts": 2500.0,
+             "dur": 2000.0, "pid": 222, "tid": 5,
+             "args": {"trace_id": _TID, "span_id": _SPAN_B,
+                      "parent_span_id": _SPAN_A}}],
+        "otherData": {"pid": 222, "role": "data_reader",
+                      "service_endpoint": "r0",
+                      "clock_anchors": [{"ts_us": 0.0,
+                                         "wall": 1003.0}]}})
+
+
+def test_assemble_corrects_skew_and_links_flows():
+    merged, report = ta.assemble([_trainer_dump(), _reader_dump()])
+    assert report["flow_links"] == 1
+    assert report["violations"] == []
+    procs = {p["role"]: p for p in report["processes"]}
+    assert procs["data_reader"]["aligned"] is True
+    assert procs["data_reader"]["correction_ms"] == pytest.approx(3000.0)
+    evs = {e["name"]: e for e in merged["traceEvents"]
+           if e.get("ph") == "X"}
+    fetch, serve = evs["dataservice.fetch"], evs["dataservice.serve"]
+    # offset-corrected: the child sits INSIDE its parent, in the
+    # reader's own pid
+    assert serve["pid"] == 222 and fetch["pid"] == 111
+    assert fetch["ts"] <= serve["ts"]
+    assert serve["ts"] + serve["dur"] <= fetch["ts"] + fetch["dur"]
+    flows = [e for e in merged["traceEvents"]
+             if e.get("ph") in ("s", "f")]
+    assert {f["ph"] for f in flows} == {"s", "f"}
+    assert flows[0]["id"] == flows[1]["id"]
+
+
+def test_assemble_without_probe_flags_violation():
+    # no clock_offsets edge: the 3 s skew stands, the child lands
+    # outside its parent, and the report says so instead of silently
+    # rendering arrows that point backwards in time
+    merged, report = ta.assemble([_trainer_dump(with_probe=False),
+                                  _reader_dump()])
+    assert report["flow_links"] == 1          # links still drawn
+    assert len(report["violations"]) == 1
+    v = report["violations"][0]
+    assert v["child"] == "dataservice.serve"
+    assert v["overhang_us"] > 1e6
+    procs = {p["role"]: p for p in report["processes"]}
+    assert procs["data_reader"]["aligned"] is False
+
+
+def test_assemble_pid_collision_resolved():
+    a, b = _trainer_dump(), _reader_dump()
+    b.pid = 111                               # same os pid on two hosts
+    for e in b.events:
+        e["pid"] = 111
+    merged, report = ta.assemble([a, b])
+    pids = {p["pid"] for p in report["processes"]}
+    assert len(pids) == 2
+
+
+def test_critpath_train_segments_and_owner_attribution():
+    tid2 = "cd" * 16
+    step_span = "d1" * 8
+    trainer = ta.Dump("t.json", {
+        "traceEvents": [
+            {"name": "train.data_wait", "ph": "X", "ts": 2000.0,
+             "dur": 6000.0, "pid": 111, "tid": 1},
+            {"name": "train.step", "ph": "X", "ts": 10000.0,
+             "dur": 10000.0, "pid": 111, "tid": 1,
+             "args": {"trace_id": tid2, "span_id": step_span,
+                      "round": 0}},
+            {"name": "train.h2d_stage", "ph": "X", "ts": 10500.0,
+             "dur": 1000.0, "pid": 111, "tid": 1,
+             "args": {"trace_id": tid2, "parent_span_id": step_span}},
+            {"name": "train.step_dispatch", "ph": "X", "ts": 11500.0,
+             "dur": 2000.0, "pid": 111, "tid": 1,
+             "args": {"trace_id": tid2, "parent_span_id": step_span}},
+            {"name": "train.device_block", "ph": "X", "ts": 13500.0,
+             "dur": 4000.0, "pid": 111, "tid": 1,
+             "args": {"trace_id": tid2, "parent_span_id": step_span}}],
+        "otherData": {"pid": 111, "role": "train",
+                      "clock_anchors": [{"ts_us": 0.0, "wall": 1000.0}]}})
+    reader = ta.Dump("r.json", {
+        "traceEvents": [
+            # overlaps [2000, 8000] of the wait window for 4000 us
+            {"name": "dataservice.serve", "ph": "X", "ts": 3000.0,
+             "dur": 4000.0, "pid": 222, "tid": 2,
+             "args": {"trace_id": tid2, "span_id": "e5" * 8}}],
+        "otherData": {"pid": 222, "role": "data_reader",
+                      "clock_anchors": [{"ts_us": 0.0, "wall": 1000.0}]}})
+    _, report = ta.assemble([trainer, reader])
+    cp = report["train"]
+    assert cp["steps"] == 1
+    segs = {k: v["total_us"] for k, v in cp["segments"].items()}
+    assert segs["data_wait"] == pytest.approx(6000.0)
+    assert segs["h2d"] == pytest.approx(1000.0)
+    assert segs["dispatch"] == pytest.approx(2000.0)
+    assert segs["device"] == pytest.approx(4000.0)
+    assert segs["other"] == pytest.approx(3000.0)
+    owners = cp["data_wait_owner_us"]
+    assert owners["data_reader (pid 222)"] == pytest.approx(4000.0)
+    assert owners["local"] == pytest.approx(2000.0)
+
+
+def test_critpath_train_data_wait_windows_are_per_trainer():
+    """Two trainers' steps interleave in fleet time; each trainer's
+    data_wait window is bounded by ITS OWN previous step, not by
+    whichever step in the fleet ended last (a shared bound silently
+    dropped waits that sat before another trainer's step end)."""
+    def _step(pid, span, ts, dur):
+        return {"name": "train.step", "ph": "X", "ts": ts, "dur": dur,
+                "pid": pid, "tid": 1,
+                "args": {"trace_id": "ab" * 16, "span_id": span,
+                         "round": 0}}
+    trainer_b = ta.Dump("b.json", {
+        "traceEvents": [
+            _step(333, "b1" * 8, 0.0, 10000.0),
+            # B's wait sits at [11000, 14000) — AFTER trainer A's step
+            # ends at 12000, which a fleet-global bound would use as lo
+            {"name": "train.data_wait", "ph": "X", "ts": 11000.0,
+             "dur": 3000.0, "pid": 333, "tid": 1},
+            _step(333, "b2" * 8, 15000.0, 10000.0)],
+        "otherData": {"pid": 333, "role": "train",
+                      "clock_anchors": [{"ts_us": 0.0, "wall": 1000.0}]}})
+    trainer_a = ta.Dump("a.json", {
+        "traceEvents": [_step(111, "a1" * 8, 5000.0, 7000.0)],
+        "otherData": {"pid": 111, "role": "train",
+                      "clock_anchors": [{"ts_us": 0.0, "wall": 1000.0}]}})
+    _, report = ta.assemble([trainer_a, trainer_b])
+    cp = report["train"]
+    assert cp["steps"] == 3
+    assert cp["segments"]["data_wait"]["total_us"] == \
+        pytest.approx(3000.0)
+
+
+def test_critpath_serve_segments_sum_to_e2e():
+    tid3 = "ef" * 16
+    req_span = "f1" * 8
+    server = ta.Dump("s.json", {
+        "traceEvents": [
+            {"name": "serve.request", "ph": "X", "ts": 0.0,
+             "dur": 10000.0, "pid": 333, "tid": 1,
+             "args": {"trace_id": tid3, "span_id": req_span}},
+            {"name": "serve.queue_wait", "ph": "X", "ts": 1000.0,
+             "dur": 3000.0, "pid": 333, "tid": 2,
+             "args": {"trace_id": tid3, "span_id": "01" * 8,
+                      "parent_span_id": req_span}},
+            {"name": "serve.batch_assembly", "ph": "X", "ts": 4000.0,
+             "dur": 1000.0, "pid": 333, "tid": 2,
+             "args": {"trace_id": tid3, "span_id": "02" * 8,
+                      "parent_span_id": req_span}},
+            {"name": "serve.infer", "ph": "X", "ts": 5000.0,
+             "dur": 4000.0, "pid": 333, "tid": 2,
+             "args": {"trace_id": tid3, "span_id": "03" * 8,
+                      "parent_span_id": req_span}},
+            {"name": "serve.respond", "ph": "X", "ts": 9200.0,
+             "dur": 600.0, "pid": 333, "tid": 1,
+             "args": {"trace_id": tid3,
+                      "parent_span_id": req_span}}],
+        "otherData": {"pid": 333, "role": "serve",
+                      "clock_anchors": [{"ts_us": 0.0, "wall": 1000.0}]}})
+    _, report = ta.assemble([server])
+    cp = report["serve"]
+    assert cp["requests"] == 1
+    segs = {k: v["mean_us"] for k, v in cp["segments"].items()}
+    e2e = cp["e2e_us"]["mean"]
+    assert e2e == pytest.approx(10000.0)
+    # the acceptance bound: segments (incl. the residual) SUM to the
+    # measured end-to-end latency within 10%
+    assert sum(segs.values()) == pytest.approx(e2e, rel=0.10)
+    assert segs["queue_wait"] == pytest.approx(3000.0)
+    assert segs["infer"] == pytest.approx(4000.0)
+    assert segs["other"] == pytest.approx(1400.0)
+
+
+def test_assemble_cli_writes_merged_and_report(tmp_path):
+    a, b = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    json.dump({"traceEvents": _trainer_dump().events,
+               "otherData": _trainer_dump().other}, open(a, "w"))
+    json.dump({"traceEvents": _reader_dump().events,
+               "otherData": _reader_dump().other}, open(b, "w"))
+    out = str(tmp_path / "fleet.json")
+    rep = str(tmp_path / "cp.json")
+    rc = ta.main([a, b, "-o", out, "--report", rep, "--strict"])
+    assert rc == 0
+    merged = json.load(open(out))
+    assert any(e.get("ph") == "s" for e in merged["traceEvents"])
+    report = json.load(open(rep))
+    assert report["flow_links"] == 1 and report["violations"] == []
+
+
+# -- config knobs ------------------------------------------------------------
+
+@pytest.mark.parametrize("key,bad", [
+    ("telemetry_trace_sample", "1.5"),
+    ("telemetry_trace_sample", "-0.1"),
+    ("telemetry_trace_tail_pct", "100"),
+    ("telemetry_trace_tail_window", "1"),
+    ("telemetry_trace_anchor_s", "0"),
+])
+def test_trace_knobs_validated(key, bad):
+    from cxxnet_tpu.config import ConfigError, parse_telemetry_config
+    with pytest.raises(ConfigError):
+        parse_telemetry_config([(key, bad)])
+
+
+def test_trace_knobs_parse():
+    from cxxnet_tpu.config import parse_telemetry_config
+    tc = parse_telemetry_config([
+        ("telemetry_trace", "/tmp/t.json"),
+        ("telemetry_trace_sample", "0.25"),
+        ("telemetry_trace_tail_pct", "5"),
+        ("telemetry_trace_tail_window", "256"),
+        ("telemetry_trace_anchor_s", "10")])
+    assert tc.trace_sample == 0.25 and tc.trace_tail_pct == 5.0
+    assert tc.trace_tail_window == 256 and tc.trace_anchor_s == 10.0

@@ -800,6 +800,31 @@ def test_report_generates_from_ledger(tmp_path):
     assert "parsed=null" in md
 
 
+def test_report_critical_path_section_and_malformed_interior(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import report
+    path = str(tmp_path / "l.jsonl")
+    ledger.RunLedger(path, "cp-1").event("run_start", task="train")
+    good = str(tmp_path / "cp.json")
+    with open(good, "w") as f:
+        json.dump({"processes": [{"pid": 1, "role": "train"}],
+                   "flow_links": 2, "violations": [],
+                   "train": {"steps": 3, "step_wall_mean_us": 1000.0,
+                             "segments": {"h2d": {"mean_us": 10.0,
+                                                  "pct": 1.0}},
+                             "data_wait_owner_us": {"local": 5.0}}}, f)
+    md = report.generate(path, None, [], trace_report=good)
+    assert "## Critical path" in md and "h2d" in md
+    # a wrong-shaped interior (hand-edited, version-skewed) must drop
+    # ONLY this section — the run report renders without the trace
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w") as f:
+        json.dump({"processes": [{"pid": 1}], "train": ["x"]}, f)
+    md = report.generate(path, None, [], trace_report=bad)
+    assert "## Critical path" not in md
+    assert "# Run report" in md
+
+
 def test_report_cli(tmp_path):
     path = str(tmp_path / "l.jsonl")
     ledger.RunLedger(path, "cli-1").event("run_start", task="train")

@@ -139,15 +139,65 @@ class _Collector:
                 self.errors.append(err)
 
 
-def _post_once(conn: http.client.HTTPConnection, body: bytes
-               ) -> Tuple[bool, str]:
-    conn.request("POST", "/predict", body=body,
-                 headers={"Content-Type": "application/json"})
+#: distributed tracer, armed by --trace-out (enable_tracing); None keeps
+#: the request path allocation-free — benches must not pay for tracing
+#: they did not ask for
+_DISTTRACE = None
+_TRACE_OUT = ""
+
+
+def enable_tracing(out_path: str) -> None:
+    """Arm client-side distributed tracing: every request runs inside a
+    ``loadgen.request`` root span whose W3C ``traceparent`` header the
+    server parents its ``serve.request`` span under, so the assembled
+    fleet trace (tools/trace_assemble.py) links loadgen -> router ->
+    queue -> infer -> respond end-to-end. Call dump_trace() afterwards
+    to land the per-process dump at ``out_path``."""
+    global _DISTTRACE, _TRACE_OUT
+    from cxxnet_tpu.telemetry.disttrace import (DISTTRACE,
+                                                set_trace_identity)
+    from cxxnet_tpu.telemetry.trace import TRACER
+    TRACER.enable()
+    DISTTRACE.enable()
+    set_trace_identity(role="loadgen")
+    _DISTTRACE = DISTTRACE
+    _TRACE_OUT = out_path
+
+
+def dump_trace() -> Optional[str]:
+    """Write the armed trace (enable_tracing) to its path; None when
+    tracing was never armed."""
+    if _DISTTRACE is None:
+        return None
+    from cxxnet_tpu.telemetry.trace import TRACER
+    _DISTTRACE.anchor(force=True)
+    n = TRACER.dump(_TRACE_OUT)
+    print(f"loadgen: {n} trace events -> {_TRACE_OUT}", file=sys.stderr)
+    return _TRACE_OUT
+
+
+def _post_raw(conn: http.client.HTTPConnection, body: bytes,
+              headers: Dict[str, str]) -> Tuple[bool, str]:
+    conn.request("POST", "/predict", body=body, headers=headers)
     r = conn.getresponse()
     payload = r.read()
     if r.status != 200:
         return False, f"HTTP {r.status}: {payload[:120]!r}"
     return True, ""
+
+
+def _post_once(conn: http.client.HTTPConnection, body: bytes
+               ) -> Tuple[bool, str]:
+    dt = _DISTTRACE
+    if dt is None:
+        return _post_raw(conn, body,
+                         {"Content-Type": "application/json"})
+    with dt.span("loadgen.request", cat="serve"):
+        headers = {"Content-Type": "application/json"}
+        tp = dt.current_traceparent()
+        if tp:                       # unsampled = zero added bytes
+            headers["traceparent"] = tp
+        return _post_raw(conn, body, headers)
 
 
 # -- closed loop --------------------------------------------------------------
@@ -379,13 +429,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="free-text provenance note for the artifact")
     ap.add_argument("-o", "--out", default="",
                     help="artifact path (default: stdout only)")
+    ap.add_argument("--trace-out", default="",
+                    help="arm distributed tracing and dump the "
+                         "client-side trace here (feeds "
+                         "tools/trace_assemble.py)")
     args = ap.parse_args(argv)
+    if args.trace_out:
+        enable_tracing(args.trace_out)
     doc = run_bench(args.url, mode=args.mode, qps=args.qps,
                     duration_s=args.duration,
                     concurrency=args.concurrency, rows=args.rows,
                     width=args.width, raw=args.raw,
                     version=args.version or None,
                     warmup_s=args.warmup, note=args.note)
+    if args.trace_out:
+        dump_trace()
     line = json.dumps(doc, sort_keys=True)
     print(line)
     if args.out:

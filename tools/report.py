@@ -197,9 +197,13 @@ def section_incidents(events: List[Dict], out: List[str]) -> None:
         else:
             extra = {k: v for k, v in e.items()
                      if k not in ("schema", "ts", "run_id", "host",
-                                  "event")}
+                                  "event", "trace_id")}
             if extra:
                 line += ": " + _fmt(extra)
+        # a row stamped with a distributed-trace id names the exact
+        # span tree to pull up in the assembled fleet trace
+        if e.get("trace_id"):
+            line += " — trace `%s`" % e["trace_id"]
         out.append(line)
         if etype == "hang_dump" and e.get("stacks"):
             first = str(e["stacks"]).strip().splitlines()
@@ -355,7 +359,13 @@ def section_telemetry(snap: Optional[Dict], out: List[str]) -> None:
             ("cxxnet_compiles_total", "compiles", 1),
             ("cxxnet_hangs_total", "hangs detected", 1),
             ("cxxnet_recompile_storms_total", "recompile storms", 1),
-            ("cxxnet_ledger_drops_total", "ledger drops", 1)):
+            ("cxxnet_ledger_drops_total", "ledger drops", 1),
+            # silent span loss must show while the run is alive, not
+            # only in the dump's otherData.dropped_events post-mortem
+            ("cxxnet_trace_dropped_total", "trace ring drops", 1),
+            ("cxxnet_trace_tail_dropped_total",
+             "trace tail-exemplar drops", 1),
+            ("cxxnet_trace_spans_total", "distributed spans kept", 1)):
         v = m.get(key)
         if v is not None:
             rows.append("| %s | %s |" % (label, _fmt(v * scale)))
@@ -390,6 +400,80 @@ def section_telemetry(snap: Optional[Dict], out: List[str]) -> None:
             out.append("| burn rate %s | %s |"
                        % (k.split("{", 1)[-1].rstrip("}"), _fmt(v)))
         out.append("")
+
+
+def section_critical_path(cp: Optional[Dict], out: List[str]) -> None:
+    """Critical path from tools/trace_assemble.py's --report JSON:
+    where train-step / serve-request time went, attributed to the
+    owning process — the "why was it slow" answer next to the "what
+    happened" timelines. A wrong-shaped interior (hand-edited,
+    version-skewed) drops ONLY this section: the run report must
+    render without the fleet trace."""
+    if not cp:
+        return
+    sec: List[str] = []
+    try:
+        _critical_path_lines(cp, sec)
+    except (AttributeError, TypeError, ValueError, KeyError):
+        return
+    out.extend(sec)
+
+
+def _critical_path_lines(cp: Dict, out: List[str]) -> None:
+    out.append("## Critical path")
+    out.append("")
+    procs = cp.get("processes") or []
+    if procs:
+        out.append("%d process(es) assembled, %d cross-process flow "
+                   "link(s), %d chain violation(s)"
+                   % (len(procs), cp.get("flow_links", 0),
+                      len(cp.get("violations") or [])))
+        out.append("")
+    train = cp.get("train")
+    if train:
+        out.append("**Train** — %d step(s), mean step wall %s ms"
+                   % (train.get("steps", 0),
+                      _fmt(train.get("step_wall_mean_us", 0) / 1e3)))
+        out.append("")
+        out.append("| segment | mean ms | share |")
+        out.append("|---|---|---|")
+        for name, seg in sorted((train.get("segments") or {}).items()):
+            out.append("| %s | %s | %s%% |" % (
+                name, _fmt(seg.get("mean_us", 0) / 1e3),
+                _fmt(seg.get("pct", 0))))
+        out.append("")
+        owners = train.get("data_wait_owner_us") or {}
+        if owners:
+            total = sum(owners.values()) or 1.0
+            out.append("data wait by owning process: "
+                       + ", ".join("%s %s%%" % (k, _fmt(100 * v / total))
+                                   for k, v in sorted(
+                                       owners.items(),
+                                       key=lambda kv: -kv[1])))
+            out.append("")
+    serve = cp.get("serve")
+    if serve:
+        e2e = serve.get("e2e_us") or {}
+        out.append("**Serve** — %d request(s), e2e p50 %s ms / p99 %s ms"
+                   % (serve.get("requests", 0),
+                      _fmt(e2e.get("p50", 0) / 1e3),
+                      _fmt(e2e.get("p99", 0) / 1e3)))
+        out.append("")
+        out.append("| segment | mean ms | p99 ms | share |")
+        out.append("|---|---|---|---|")
+        for name, seg in sorted((serve.get("segments") or {}).items()):
+            out.append("| %s | %s | %s | %s%% |" % (
+                name, _fmt(seg.get("mean_us", 0) / 1e3),
+                _fmt(seg.get("p99_us", 0) / 1e3),
+                _fmt(seg.get("pct", 0))))
+        out.append("")
+        slow = serve.get("slowest_requests") or []
+        if slow:
+            t = slow[0]
+            out.append("slowest request: %s ms end-to-end (trace `%s`)"
+                       % (_fmt(t.get("e2e_us", 0) / 1e3),
+                          t.get("trace_id", "?")))
+            out.append("")
 
 
 def section_bench(paths: List[str], out: List[str]) -> None:
@@ -428,10 +512,23 @@ def section_bench(paths: List[str], out: List[str]) -> None:
     out.append("")
 
 
+def load_trace_report(path: str) -> Optional[Dict[str, Any]]:
+    """trace_assemble.py --report JSON; None (section skipped) on any
+    malformation — the run report must render without the fleet trace."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
 def generate(ledger_path: str, telemetry_log: Optional[str],
-             bench_paths: List[str]) -> str:
+             bench_paths: List[str],
+             trace_report: Optional[str] = None) -> str:
     events = load_ledger(ledger_path) if ledger_path else []
     snap = load_last_snapshot(telemetry_log) if telemetry_log else None
+    cp = load_trace_report(trace_report) if trace_report else None
     out: List[str] = []
     section_identity(events, out)
     section_rounds(events, out)
@@ -439,6 +536,7 @@ def generate(ledger_path: str, telemetry_log: Optional[str],
     section_serving(events, out)
     section_topology(events, out)
     section_checkpoints(events, out)
+    section_critical_path(cp, out)
     section_telemetry(snap, out)
     section_bench(bench_paths, out)
     out.append("---")
@@ -455,6 +553,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="telemetry_log JSONL (last snapshot is used)")
     ap.add_argument("--bench", nargs="*", default=[],
                     help="BENCH_r*.json paths or globs")
+    ap.add_argument("--trace-report", default="",
+                    help="critical-path JSON from tools/"
+                         "trace_assemble.py --report")
     ap.add_argument("-o", "--out", default="",
                     help="output path (default: stdout)")
     args = ap.parse_args(argv)
@@ -462,7 +563,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     for pat in args.bench:
         hits = sorted(glob.glob(pat))
         bench.extend(hits if hits else [pat])
-    md = generate(args.ledger, args.telemetry_log or None, bench)
+    md = generate(args.ledger, args.telemetry_log or None, bench,
+                  trace_report=args.trace_report or None)
     if args.out:
         with open(args.out, "w", encoding="utf-8") as f:
             f.write(md)

@@ -1,0 +1,280 @@
+#!/usr/bin/env python
+"""Distributed-tracing smoke (tier-1-adjacent; CPU-safe, multi-process).
+
+Drives the fleet trace plane end to end — the PR-14 acceptance run
+(doc/tasks.md "Distributed tracing"):
+
+  1. **data service**: a READER process (``task = data_reader``) and a
+     TRAINER process (``task = train`` + ``data_service = host:port``),
+     both with ``telemetry_trace`` on. After both exit,
+     tools/trace_assemble.py merges their dumps and the smoke asserts a
+     trainer-side ``dataservice.fetch`` span whose CHILD
+     ``dataservice.serve`` span lives in the reader's pid, with the
+     cross-process flow link present and every offset-corrected
+     parent/child chain time-monotone (no violations).
+  2. **serve**: an in-process ServeServer (this process) under load
+     from a tools/loadgen.py SUBPROCESS with ``--trace-out`` — each
+     request carries a W3C ``traceparent`` header. The assembled trace
+     must link every server-side ``serve.request`` span under a
+     loadgen-side client span, and each request's critical path
+     (queue_wait / batch_assembly / infer / respond / other) must SUM
+     to within 10% of its measured end-to-end latency.
+
+Exits nonzero on any failure.  Run:
+    JAX_PLATFORMS=cpu python tools/smoke_disttrace.py
+(sibling of tools/smoke_dataservice.py / smoke_serve.py / smoke_fleet.py)
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+DATA_SECTION = """
+data = train
+iter = synthetic
+  num_inst = 256
+  num_class = 5
+  input_shape = 1,1,16
+iter = end
+"""
+
+NET_CFG = """
+netconfig=start
+layer[+1:h1] = fullc:fc1
+  nhidden = 24
+  random_type = xavier
+layer[+1:a1] = relu
+layer[a1->out] = fullc:fc2
+  nhidden = 5
+  random_type = xavier
+layer[+0] = softmax
+netconfig=end
+eta = 0.02
+eval_train = 0
+print_step = 0
+metric = error
+"""
+
+COMMON = """
+input_shape = 1,1,16
+batch_size = 32
+dev = cpu
+silent = 1
+save_model = 0
+io_retry_attempts = 2
+io_retry_base_ms = 5
+io_retry_max_ms = 50
+data_service_shards = 2
+data_service_timeout_ms = 2000
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _write_conf(td, name, text):
+    path = os.path.join(td, name)
+    with open(path, "w") as f:
+        f.write(text)
+    return path
+
+
+def _spawn(args, log_path):
+    log = open(log_path, "w")
+    return subprocess.Popen(
+        args, cwd=_REPO, stdout=log, stderr=subprocess.STDOUT,
+        env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONUNBUFFERED="1"))
+
+
+def _load_spans(merged, name):
+    return [e for e in merged["traceEvents"]
+            if e.get("ph") == "X" and e.get("name") == name]
+
+
+def _args(ev):
+    return ev.get("args") or {}
+
+
+def phase_dataservice(td) -> None:
+    import trace_assemble as ta
+
+    port = _free_port()
+    endpoint = f"127.0.0.1:{port}"
+    reader_trace = os.path.join(td, "reader_trace.json")
+    trainer_trace = os.path.join(td, "trainer_trace.json")
+
+    reader_conf = _write_conf(td, "reader.conf", (
+        "task = data_reader\n"
+        f"data_service = {endpoint}\n"
+        "data_service_reader = 0\n"
+        f"telemetry_trace = {reader_trace}\n"
+        + COMMON + DATA_SECTION))
+    reader = _spawn([sys.executable, "-m", "cxxnet_tpu.main",
+                     reader_conf], os.path.join(td, "reader.log"))
+    try:
+        trainer_conf = _write_conf(td, "trainer.conf", (
+            "task = train\n"
+            f"data_service = {endpoint}\n"
+            "num_round = 3\n"
+            f"model_dir = {os.path.join(td, 'models')}\n"
+            f"telemetry_trace = {trainer_trace}\n"
+            + COMMON + NET_CFG + DATA_SECTION))
+        trainer = _spawn([sys.executable, "-m", "cxxnet_tpu.main",
+                          trainer_conf], os.path.join(td, "trainer.log"))
+        rc = trainer.wait(timeout=300)
+        tlog = open(os.path.join(td, "trainer.log")).read()
+        assert rc == 0, f"trainer rc={rc}\n{tlog[-2000:]}"
+        assert "degraded" not in tlog, (
+            "trainer degraded off the service — no cross-process spans "
+            "to assert\n" + tlog[-2000:])
+    finally:
+        # SIGTERM (not SIGKILL): the reader's trace dump happens in its
+        # telemetry close
+        if reader.poll() is None:
+            os.kill(reader.pid, signal.SIGTERM)
+        reader.wait(timeout=60)
+
+    assert os.path.exists(trainer_trace), "trainer trace dump missing"
+    assert os.path.exists(reader_trace), "reader trace dump missing"
+    dumps = [ta.load_dump(trainer_trace), ta.load_dump(reader_trace)]
+    merged, report = ta.assemble(dumps)
+    procs = {p["role"]: p for p in report["processes"]}
+    assert "train" in procs and "data_reader" in procs, procs
+    reader_pid = procs["data_reader"]["pid"]
+    trainer_pid = procs["train"]["pid"]
+    assert reader_pid == reader.pid, (reader_pid, reader.pid)
+
+    fetches = {_args(e)["span_id"]: e
+               for e in _load_spans(merged, "dataservice.fetch")
+               if e["pid"] == trainer_pid and "span_id" in _args(e)}
+    assert fetches, "no dataservice.fetch spans in the trainer dump"
+    serves = [e for e in _load_spans(merged, "dataservice.serve")
+              if e["pid"] == reader_pid
+              and _args(e).get("parent_span_id") in fetches]
+    assert serves, (
+        "no reader-side dataservice.serve span parented under a "
+        "trainer-side fetch span")
+    # the slow half of the answer: the reader's DECODE as a grandchild
+    serve_ids = {_args(e)["span_id"] for e in serves
+                 if "span_id" in _args(e)}
+    decodes = [e for e in _load_spans(merged, "dataservice.decode")
+               if e["pid"] == reader_pid
+               and _args(e).get("parent_span_id") in serve_ids]
+    assert decodes, ("no dataservice.decode child span in the reader's "
+                     "pid (every first-touch fetch decodes inline)")
+    assert report["flow_links"] >= 1, report
+    assert report["violations"] == [], (
+        "offset-corrected chains are not time-monotone: "
+        f"{report['violations'][:3]}")
+    # the trainer probed the reader's clock over the wire
+    assert procs["data_reader"]["aligned"], procs
+    cp = report.get("train")
+    assert cp and cp["steps"] >= 1, cp
+    print(f"smoke_disttrace: data service ok — {len(fetches)} fetch "
+          f"span(s), {len(serves)} reader-side serve span(s), "
+          f"{len(decodes)} decode span(s) in pid {reader_pid}, "
+          f"{report['flow_links']} flow link(s), 0 violations, "
+          f"{cp['steps']} train step(s) in the critical path")
+
+
+def phase_serve(td) -> None:
+    import numpy as np  # noqa: F401  (engine deps)
+    import trace_assemble as ta
+    from cxxnet_tpu import wrapper
+    from cxxnet_tpu.config import parse_config_string
+    from cxxnet_tpu.io.data import create_iterator
+    from cxxnet_tpu.serve.server import ServeServer
+    from cxxnet_tpu.telemetry.disttrace import (DISTTRACE,
+                                                set_trace_identity)
+    from cxxnet_tpu.telemetry.trace import TRACER
+    from cxxnet_tpu.trainer import Trainer
+
+    net_cfg = NET_CFG + "input_shape = 1,1,16\nbatch_size = 64\ndev = cpu\n"
+    tr = Trainer(parse_config_string(net_cfg))
+    tr.init_model()
+    for batch in create_iterator(parse_config_string(
+            "iter = synthetic\nnum_inst = 256\nbatch_size = 64\n"
+            "num_class = 5\ninput_shape = 1,1,16\nseed_data = 3\n")):
+        tr.update(batch)
+    model = os.path.join(td, "0000.model")
+    tr.save_model(model)
+
+    server_trace = os.path.join(td, "server_trace.json")
+    loadgen_trace = os.path.join(td, "loadgen_trace.json")
+    TRACER.enable()
+    TRACER.clear()
+    DISTTRACE.enable()
+    set_trace_identity(role="serve")
+    engine = wrapper.create_engine(net_cfg, model, buckets="2,4,8",
+                                   max_batch=8)
+    srv = ServeServer(engine, port=0, max_latency_ms=10,
+                      log_interval_s=0, silent=True).start()
+    try:
+        lg = _spawn([sys.executable, os.path.join("tools", "loadgen.py"),
+                     "--url", f"http://127.0.0.1:{srv.port}",
+                     "--mode", "closed", "--duration", "3",
+                     "--concurrency", "4", "--width", "16",
+                     "--warmup", "1", "--trace-out", loadgen_trace],
+                    os.path.join(td, "loadgen.log"))
+        rc = lg.wait(timeout=300)
+        llog = open(os.path.join(td, "loadgen.log")).read()
+        assert rc == 0, f"loadgen rc={rc}\n{llog[-2000:]}"
+    finally:
+        srv.stop()
+        DISTTRACE.anchor(force=True)
+        TRACER.dump(server_trace)
+        DISTTRACE.disable()
+        TRACER.disable()
+
+    dumps = [ta.load_dump(server_trace), ta.load_dump(loadgen_trace)]
+    merged, report = ta.assemble(dumps, ref="serve")
+    assert report["violations"] == [], report["violations"][:3]
+    assert report["flow_links"] >= 1, report
+    cp = report["serve"]
+    assert cp and cp["requests"] >= 4, cp
+    # every server-side request span hangs under a loadgen client span
+    assert cp["client_linked"] == cp["requests"], cp
+    # acceptance bound: the per-request critical path (queue_wait +
+    # batch_assembly + infer + respond + other) sums to within 10% of
+    # the measured end-to-end latency
+    seg_sum = sum(s["mean_us"] for s in cp["segments"].values())
+    e2e = cp["e2e_us"]["mean"]
+    assert abs(seg_sum - e2e) <= 0.10 * e2e, (seg_sum, e2e)
+    # ... and is not all residual: the attributed segments carry the
+    # request (the batcher's queue/assembly/infer records landed)
+    attributed = sum(s["mean_us"] for k, s in cp["segments"].items()
+                     if k != "other")
+    assert attributed >= 0.5 * e2e, cp["segments"]
+    print(f"smoke_disttrace: serve ok — {cp['requests']} request(s) "
+          f"linked loadgen->server, critical path sums to "
+          f"{100.0 * seg_sum / e2e:.1f}% of e2e "
+          f"(attributed {100.0 * attributed / e2e:.1f}%), "
+          f"{report['flow_links']} flow link(s), 0 violations")
+
+
+def main() -> int:
+    t0 = time.time()
+    td = tempfile.mkdtemp(prefix="smoke_disttrace_")
+    phase_dataservice(td)
+    phase_serve(td)
+    print(f"smoke_disttrace: PASS ({time.time() - t0:.1f}s, "
+          f"artifacts in {td})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

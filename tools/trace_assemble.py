@@ -1,0 +1,562 @@
+#!/usr/bin/env python
+"""Fleet trace assembler: N per-host trace dumps -> one perfetto trace.
+
+Each process dumps its own Chrome-trace JSON (``telemetry_trace=path``;
+``telemetry/trace.py``), with timestamps on that process's PRIVATE
+``time.perf_counter()`` timescale — meaningless across processes. This
+tool merges N such dumps into one fleet trace a human loads in
+Perfetto / chrome://tracing to answer "why was this step/request slow"
+when the cause lives in another process:
+
+* **clock alignment** — every dump carries ``otherData.clock_anchors``
+  (``perf_counter``<->``time.time`` pairs re-sampled by
+  telemetry/disttrace.py), mapping its private timescale onto its host's
+  wall clock; ``otherData.clock_offsets`` (NTP-style wire-handshake
+  probes against named peer endpoints, ``DataServiceClient.probe_clock``)
+  then correct for wall clocks DISAGREEING between hosts. Offsets chain:
+  a reference process is chosen (``--ref``, default the first
+  trainer-role dump) and every dump reachable over probe edges is pulled
+  onto its timeline; unreachable dumps are assumed NTP-synced and
+  flagged ``aligned: false`` in the process table.
+* **flow links** — spans recorded by ``telemetry/disttrace.py`` carry
+  ``trace_id``/``span_id``/``parent_span_id`` in ``args``; wherever a
+  parent and child landed in different processes (a trainer's
+  ``dataservice.fetch`` whose child ``dataservice.serve`` ran in the
+  reader, a loadgen request whose child ``serve.request`` ran in the
+  server) the assembler emits Chrome flow events ("s"/"f") so the UI
+  draws the cross-process arrow.
+* **critical path** — a machine-readable report (``--report``):
+  per train step, where the time went (``data_wait`` — attributed to
+  the owning process: a reader's decode vs the wire vs local — ``h2d``,
+  ``dispatch``, ``device``, ``other``); per serve request, ``queue_wait``
+  vs ``batch_assembly`` vs ``infer`` vs ``respond`` vs ``other``, with
+  per-segment aggregates and the slowest exemplars. tools/report.py
+  renders this as the run report's "Critical path" section.
+* **chain validation** — after offset correction every parent/child
+  chain must be time-monotone (child inside parent, up to the probe's
+  rtt/2 uncertainty + anchor drift); violations land in the report's
+  ``violations`` list (and fail ``--strict``), because a fleet trace
+  whose arrows point backwards in time is worse than no trace.
+
+Usage:
+  python tools/trace_assemble.py host0.json host1.json ... \
+      -o fleet_trace.json [--report critpath.json] [--ref ROLE|PID] \
+      [--strict] [--tolerance-ms 2.0]
+
+Stdlib-only: runs anywhere the dumps land, no jax / no repo deps.
+"""
+
+from __future__ import annotations
+
+import argparse
+import bisect
+import json
+import sys
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+# span names with fixed meaning for the critical-path report
+_TRAIN_STEP = "train.step"
+_TRAIN_SEGMENTS = {"train.h2d_stage": "h2d",
+                   "train.step_dispatch": "dispatch",
+                   "train.device_block": "device"}
+_DATA_WAIT = "train.data_wait"
+_READER_SPANS = ("dataservice.serve", "dataservice.decode")
+_SERVE_REQUEST = "serve.request"
+_SERVE_SEGMENTS = {"serve.queue_wait": "queue_wait",
+                   "serve.batch_assembly": "batch_assembly",
+                   "serve.infer": "infer",
+                   "serve.respond": "respond",
+                   "serve.route": "route",
+                   "serve.parse": "parse"}
+
+
+class Dump:
+    """One per-process trace file, parsed and wall-clock-anchored."""
+
+    def __init__(self, path: str, doc: Dict[str, Any]):
+        self.path = path
+        events = doc.get("traceEvents", [])
+        self.meta = [e for e in events if e.get("ph") == "M"]
+        self.events = [e for e in events if e.get("ph") != "M"]
+        self.other: Dict[str, Any] = doc.get("otherData", {}) or {}
+        self.pid = self.other.get("pid")
+        if self.pid is None:                    # pre-anchor dumps
+            self.pid = next((e.get("pid") for e in self.events
+                             if "pid" in e), 0)
+        self.role = str(self.other.get("role", "?"))
+        self.endpoint = self.other.get("service_endpoint")
+        # anchors sorted by ring-timescale position for nearest lookup
+        self.anchors = sorted(
+            (a for a in self.other.get("clock_anchors", ())
+             if isinstance(a, dict) and "ts_us" in a and "wall" in a),
+            key=lambda a: a["ts_us"])
+        self.offsets: Dict[str, Dict[str, float]] = {
+            str(k): v for k, v in
+            (self.other.get("clock_offsets") or {}).items()
+            if isinstance(v, dict) and "offset_s" in v}
+        # filled by the assembler
+        self.correction_s = 0.0     # subtract from wall -> ref frame
+        self.aligned = False        # reachable over a probe edge (or ref)
+        self.rtt_s = 0.0            # uncertainty of the chain used
+        self.out_pid = self.pid     # collision-resolved pid in the merge
+
+    def wall(self, ts_us: float) -> Optional[float]:
+        """Host wall-clock seconds for a ring timestamp, via the nearest
+        anchor (re-sampled anchors bound perf_counter-vs-wall drift).
+        None when the dump carries no anchors (plain-TRACER dump)."""
+        if not self.anchors:
+            return None
+        best = self.anchors[0]
+        for a in self.anchors:
+            if abs(a["ts_us"] - ts_us) <= abs(best["ts_us"] - ts_us):
+                best = a
+        return best["wall"] + (ts_us - best["ts_us"]) / 1e6
+
+    def label(self) -> str:
+        bits = [self.role, f"pid {self.pid}"]
+        if self.other.get("host") is not None:
+            bits.insert(1, f"host {self.other['host']}")
+        if self.endpoint:
+            bits.append(str(self.endpoint))
+        return " ".join(str(b) for b in bits)
+
+
+def load_dump(path: str) -> Dump:
+    with open(path, encoding="utf-8") as f:
+        return Dump(path, json.load(f))
+
+
+# -- clock-offset resolution --------------------------------------------------
+
+def resolve_offsets(dumps: List[Dump], ref_idx: int) -> None:
+    """BFS the probe graph from the reference dump, accumulating the
+    per-dump wall-clock correction (seconds to SUBTRACT to land on the
+    reference host's timeline). A probe edge exists where dump A holds
+    a ``clock_offsets[endpoint]`` entry and dump B identifies as
+    ``service_endpoint == endpoint`` (offset = B's wall minus A's wall,
+    ``telemetry.disttrace.estimate_offset``). Edges traverse both ways;
+    rtt/2 uncertainties accumulate along the chain."""
+    by_endpoint: Dict[str, int] = {
+        d.endpoint: i for i, d in enumerate(dumps) if d.endpoint}
+    ref = dumps[ref_idx]
+    ref.aligned = True
+    queue = deque([ref_idx])
+    while queue:
+        i = queue.popleft()
+        a = dumps[i]
+        # forward: a probed peer endpoints
+        for ep, probe in a.offsets.items():
+            j = by_endpoint.get(ep)
+            if j is None or dumps[j].aligned:
+                continue
+            b = dumps[j]
+            b.correction_s = a.correction_s + float(probe["offset_s"])
+            b.rtt_s = a.rtt_s + float(probe.get("rtt_s", 0.0))
+            b.aligned = True
+            queue.append(j)
+        # reverse: someone probed a's endpoint
+        if a.endpoint:
+            for j, b in enumerate(dumps):
+                if b.aligned:
+                    continue
+                probe = b.offsets.get(a.endpoint)
+                if probe is None:
+                    continue
+                b.correction_s = a.correction_s - float(probe["offset_s"])
+                b.rtt_s = a.rtt_s + float(probe.get("rtt_s", 0.0))
+                b.aligned = True
+                queue.append(j)
+
+
+def pick_reference(dumps: List[Dump], ref: str = "") -> int:
+    """--ref matches a role name or a pid; default: the first
+    trainer-role dump, else dump 0 (stable, documented)."""
+    if ref:
+        for i, d in enumerate(dumps):
+            if d.role == ref or str(d.pid) == ref:
+                return i
+        raise SystemExit(f"--ref {ref!r} matches no dump "
+                         f"(roles: {[d.role for d in dumps]})")
+    for i, d in enumerate(dumps):
+        if d.role in ("train", "trainer", "finetune"):
+            return i
+    return 0
+
+
+# -- assembly -----------------------------------------------------------------
+
+def _span_args(ev: Dict[str, Any]) -> Dict[str, Any]:
+    a = ev.get("args")
+    return a if isinstance(a, dict) else {}
+
+
+def assemble(dumps: List[Dump], ref: str = "",
+             tolerance_ms: float = 2.0
+             ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """(merged trace doc, critical-path report). Pure function of the
+    parsed dumps — the unit tests and the two-process smoke drive it
+    directly."""
+    ref_idx = pick_reference(dumps, ref)
+    resolve_offsets(dumps, ref_idx)
+
+    # pid collisions (two hosts, same os pid): keep original pids when
+    # unique — the smoke asserts "the decode span lives in the reader's
+    # pid" — and offset later dumps only on collision
+    seen_pids: Dict[int, int] = {}
+    for i, d in enumerate(dumps):
+        if d.pid in seen_pids:
+            d.out_pid = 1000000 * (i + 1) + int(d.pid)
+        seen_pids.setdefault(d.pid, i)
+
+    # corrected wall-clock placement; dumps with no anchors cannot be
+    # dated — they render on their private timescale from the global
+    # origin and are flagged unaligned
+    placed: List[Tuple[Dump, Dict[str, Any], float]] = []
+    walls: List[float] = []
+    for d in dumps:
+        for ev in d.events:
+            ts = ev.get("ts")
+            if ts is None:
+                continue
+            w = d.wall(ts)
+            w = (w - d.correction_s) if w is not None else None
+            if w is not None:
+                walls.append(w)
+            placed.append((d, ev, w))
+    t0 = min(walls) if walls else 0.0
+
+    out_events: List[Dict[str, Any]] = []
+    spans: Dict[str, Tuple[Dict[str, Any], Dump]] = {}
+    children: List[Tuple[Dict[str, Any], Dump]] = []
+    for d, ev, w in placed:
+        ne = dict(ev)
+        ne["pid"] = d.out_pid
+        ne["ts"] = round((w - t0) * 1e6, 3) if w is not None \
+            else ev.get("ts", 0.0)
+        out_events.append(ne)
+        a = _span_args(ne)
+        sid = a.get("span_id")
+        if sid:
+            spans[sid] = (ne, d)
+        if a.get("parent_span_id"):
+            children.append((ne, d))
+
+    # flow links wherever a parent/child pair crosses a process boundary
+    flows: List[Dict[str, Any]] = []
+    violations: List[Dict[str, Any]] = []
+    for ev, d in children:
+        a = _span_args(ev)
+        parent = spans.get(a["parent_span_id"])
+        if parent is None:
+            continue
+        pev, pd = parent
+        if pd.out_pid != d.out_pid:
+            fid = int(a.get("span_id", a["parent_span_id"])[:15] or "0",
+                      16)
+            flows.append({"ph": "s", "cat": "disttrace",
+                          "name": ev.get("name", "span"), "id": fid,
+                          "pid": pev["pid"], "tid": pev.get("tid", 0),
+                          "ts": pev["ts"]})
+            flows.append({"ph": "f", "bp": "e", "cat": "disttrace",
+                          "name": ev.get("name", "span"), "id": fid,
+                          "pid": ev["pid"], "tid": ev.get("tid", 0),
+                          "ts": ev["ts"]})
+        # chain monotonicity: after correction the child must sit inside
+        # its parent, up to the offset chain's rtt/2 + the configured
+        # anchor-drift tolerance
+        tol_us = tolerance_ms * 1e3 + (d.rtt_s + pd.rtt_s) / 2.0 * 1e6
+        c0, c1 = ev["ts"], ev["ts"] + ev.get("dur", 0.0)
+        p0, p1 = pev["ts"], pev["ts"] + pev.get("dur", 0.0)
+        if c0 < p0 - tol_us or c1 > p1 + tol_us:
+            violations.append({
+                "child": ev.get("name"), "parent": pev.get("name"),
+                "child_pid": ev["pid"], "parent_pid": pev["pid"],
+                "child_ts_us": c0, "parent_ts_us": p0,
+                "overhang_us": round(max(p0 - c0, c1 - p1), 3),
+                "tolerance_us": round(tol_us, 3)})
+
+    meta_events: List[Dict[str, Any]] = []
+    for i, d in enumerate(dumps):
+        meta_events.append({"name": "process_name", "ph": "M",
+                            "pid": d.out_pid,
+                            "args": {"name": d.label()}})
+        meta_events.append({"name": "process_sort_index", "ph": "M",
+                            "pid": d.out_pid, "args": {"sort_index": i}})
+        for m in d.meta:
+            nm = dict(m)
+            nm["pid"] = d.out_pid
+            meta_events.append(nm)
+
+    merged = {
+        "traceEvents": meta_events + out_events + flows,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "producer": "tools/trace_assemble.py",
+            "hosts": len(dumps),
+            "reference": dumps[ref_idx].label(),
+            "dropped_events": sum(int(d.other.get("dropped_events", 0))
+                                  for d in dumps),
+        },
+    }
+    report = {
+        "schema": "cxxnet-critpath-v1",
+        "processes": [{"pid": d.out_pid, "orig_pid": d.pid,
+                       "role": d.role, "file": d.path,
+                       "aligned": d.aligned,
+                       "correction_ms": round(d.correction_s * 1e3, 3),
+                       "rtt_ms": round(d.rtt_s * 1e3, 3),
+                       "events": len(d.events)}
+                      for d in dumps],
+        "flow_links": len(flows) // 2,
+        "violations": violations,
+        "train": _critpath_train(out_events, dumps),
+        "serve": _critpath_serve(out_events, spans),
+    }
+    return merged, report
+
+
+# -- critical path: train steps ----------------------------------------------
+
+def _critpath_train(events: List[Dict[str, Any]], dumps: List[Dump]
+                    ) -> Optional[Dict[str, Any]]:
+    """Per train step: data_wait / h2d / dispatch / device / other,
+    with the data_wait attributed to the process that owned it — the
+    reader whose serve/decode span overlaps the wait window, otherwise
+    the trainer's own (local pipeline / wire) time."""
+    roles = {d.out_pid: d.role for d in dumps}
+    steps = sorted((e for e in events if e.get("name") == _TRAIN_STEP
+                    and "span_id" in _span_args(e)),
+                   key=lambda e: e["ts"])
+    if not steps:
+        return None
+    # waits grouped per trainer pid, time-sorted: each step consumes
+    # its window with an advancing index (O(steps + waits)) — a per-step
+    # rescan of the full list is quadratic on overnight-run traces
+    waits_by_pid: Dict[Any, List[Dict[str, Any]]] = {}
+    for e in sorted((e for e in events if e.get("name") == _DATA_WAIT),
+                    key=lambda e: e["ts"]):
+        waits_by_pid.setdefault(e["pid"], []).append(e)
+    wait_idx: Dict[Any, int] = {}
+    # reader serve/decode spans time-sorted for bisected overlap lookup
+    # (a full scan per wait is the same quadratic blow-up as the step
+    # rescan above, on the reader dump's side)
+    remote = sorted((e for e in events if e.get("name") in _READER_SPANS),
+                    key=lambda e: e["ts"])
+    remote_ts = [r["ts"] for r in remote]
+    remote_max_dur = max((r.get("dur", 0.0) for r in remote),
+                         default=0.0)
+    by_parent: Dict[str, List[Dict[str, Any]]] = {}
+    for e in events:
+        p = _span_args(e).get("parent_span_id")
+        if p:
+            by_parent.setdefault(p, []).append(e)
+
+    seg_tot = {"data_wait": 0.0, "h2d": 0.0, "dispatch": 0.0,
+               "device": 0.0, "other": 0.0}
+    attrib: Dict[str, float] = {}
+    wall_tot = 0.0
+    # previous step end PER TRAINER PID: steps are globally time-sorted
+    # across the fleet, so a shared bound would let trainer A's steps
+    # clip the data_wait windows between trainer B's own steps
+    prev_end: Dict[Any, float] = {}
+    per_step: List[Dict[str, Any]] = []
+    for step in steps:
+        s0, s1 = step["ts"], step["ts"] + step.get("dur", 0.0)
+        wall_tot += s1 - s0
+        segs = dict.fromkeys(seg_tot, 0.0)
+        # data_wait: the pulls between the previous step and this one
+        # (the batch pull happens OUTSIDE the step span, by design —
+        # the step span brackets the update)
+        lo = prev_end.get(step["pid"], -1e18)
+        pid_waits = waits_by_pid.get(step["pid"], ())
+        i = wait_idx.get(step["pid"], 0)
+        while i < len(pid_waits) and pid_waits[i]["ts"] < s0:
+            w = pid_waits[i]
+            i += 1
+            if w["ts"] < lo:
+                continue      # fell inside the previous step's window
+            dur = w.get("dur", 0.0)
+            segs["data_wait"] += dur
+            # attribute the wait to the process whose decode/serve span
+            # overlaps it (offset-corrected): that is whose time it was
+            w0, w1 = w["ts"], w["ts"] + dur
+            covered = 0.0
+            j = bisect.bisect_left(remote_ts, w0 - remote_max_dur)
+            while j < len(remote) and remote_ts[j] < w1:
+                r = remote[j]
+                j += 1
+                if r["pid"] == step["pid"]:
+                    continue
+                o = min(w1, r["ts"] + r.get("dur", 0.0)) - max(w0, r["ts"])
+                if o > 0:
+                    key = "%s (pid %s)" % (roles.get(r["pid"], "?"),
+                                           r["pid"])
+                    attrib[key] = attrib.get(key, 0.0) + o
+                    covered += o
+            attrib["local"] = attrib.get("local", 0.0) \
+                + max(0.0, dur - covered)
+        wait_idx[step["pid"]] = i
+        for ch in by_parent.get(_span_args(step)["span_id"], ()):
+            seg = _TRAIN_SEGMENTS.get(ch.get("name"))
+            if seg:
+                d0 = max(ch["ts"], s0)
+                d1 = min(ch["ts"] + ch.get("dur", 0.0), s1)
+                segs[seg] += max(0.0, d1 - d0)
+        segs["other"] = max(0.0, (s1 - s0) - segs["h2d"]
+                            - segs["dispatch"] - segs["device"])
+        for k in seg_tot:
+            seg_tot[k] += segs[k]
+        per_step.append({"round": _span_args(step).get("round"),
+                         "wall_us": round(s1 - s0, 1),
+                         **{k: round(v, 1) for k, v in segs.items()}})
+        prev_end[step["pid"]] = s1
+    n = len(steps)
+    denom = wall_tot + seg_tot["data_wait"] or 1.0
+    return {
+        "steps": n,
+        "step_wall_mean_us": round(wall_tot / n, 1),
+        "segments": {k: {"total_us": round(v, 1),
+                         "mean_us": round(v / n, 1),
+                         "pct": round(100.0 * v / denom, 2)}
+                     for k, v in seg_tot.items()},
+        "data_wait_owner_us": {k: round(v, 1)
+                               for k, v in sorted(attrib.items())},
+        "slowest_steps": sorted(per_step, key=lambda s: -s["wall_us"])[:5],
+    }
+
+
+# -- critical path: serve requests -------------------------------------------
+
+def _pctl(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1,
+            max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[i]
+
+
+def _critpath_serve(events: List[Dict[str, Any]],
+                    spans: Dict[str, Tuple[Dict[str, Any], Any]]
+                    ) -> Optional[Dict[str, Any]]:
+    """Per serve request: queue_wait / batch_assembly / infer /
+    respond / other, each clipped to the request window; ``other`` is
+    the residual, so the segments SUM to the request's end-to-end
+    latency (the smoke pins the 10% self-consistency bound)."""
+    reqs = [e for e in events if e.get("name") == _SERVE_REQUEST
+            and "span_id" in _span_args(e)]
+    if not reqs:
+        return None
+    by_parent: Dict[str, List[Dict[str, Any]]] = {}
+    for e in events:
+        p = _span_args(e).get("parent_span_id")
+        if p:
+            by_parent.setdefault(p, []).append(e)
+    seg_names = sorted(set(_SERVE_SEGMENTS.values())) + ["other"]
+    samples: Dict[str, List[float]] = {k: [] for k in seg_names}
+    e2e: List[float] = []
+    per_req: List[Dict[str, Any]] = []
+    linked = 0
+    for req in reqs:
+        r0, r1 = req["ts"], req["ts"] + req.get("dur", 0.0)
+        segs = dict.fromkeys(seg_names, 0.0)
+        for ch in by_parent.get(_span_args(req)["span_id"], ()):
+            seg = _SERVE_SEGMENTS.get(ch.get("name"))
+            if seg is None:
+                continue
+            d0 = max(ch["ts"], r0)
+            d1 = min(ch["ts"] + ch.get("dur", 0.0), r1)
+            segs[seg] += max(0.0, d1 - d0)
+        attributed = sum(segs.values())
+        segs["other"] = max(0.0, (r1 - r0) - attributed)
+        if _span_args(req).get("parent_span_id") in spans:
+            linked += 1                       # client-side span present
+        for k, v in segs.items():
+            samples[k].append(v)
+        e2e.append(r1 - r0)
+        per_req.append({"e2e_us": round(r1 - r0, 1),
+                        "trace_id": _span_args(req).get("trace_id"),
+                        **{k: round(v, 1) for k, v in segs.items()}})
+    n = len(reqs)
+    e2e_sorted = sorted(e2e)
+    return {
+        "requests": n,
+        "client_linked": linked,
+        "e2e_us": {"mean": round(sum(e2e) / n, 1),
+                   "p50": round(_pctl(e2e_sorted, 0.50), 1),
+                   "p99": round(_pctl(e2e_sorted, 0.99), 1)},
+        "segments": {k: {"mean_us": round(sum(v) / n, 1),
+                         "p99_us": round(_pctl(sorted(v), 0.99), 1),
+                         "pct": round(100.0 * sum(v)
+                                      / (sum(e2e) or 1.0), 2)}
+                     for k, v in samples.items()},
+        "slowest_requests":
+            sorted(per_req, key=lambda r: -r["e2e_us"])[:5],
+    }
+
+
+# -- CLI ----------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("dumps", nargs="+",
+                    help="per-host trace JSONs (telemetry_trace=...)")
+    ap.add_argument("-o", "--out", default="fleet_trace.json",
+                    help="merged perfetto-loadable trace path")
+    ap.add_argument("--report", default="",
+                    help="critical-path report JSON path")
+    ap.add_argument("--ref", default="",
+                    help="reference dump: role name or pid "
+                         "(default: first trainer, else first dump)")
+    ap.add_argument("--tolerance-ms", type=float, default=2.0,
+                    help="chain-validation slack on top of probe rtt/2")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit nonzero on chain violations")
+    args = ap.parse_args(argv)
+
+    dumps = []
+    for p in args.dumps:
+        try:
+            dumps.append(load_dump(p))
+        except (OSError, ValueError) as e:
+            print(f"trace_assemble: skipping {p}: {e}", file=sys.stderr)
+    if not dumps:
+        print("trace_assemble: no loadable dumps", file=sys.stderr)
+        return 2
+    merged, report = assemble(dumps, ref=args.ref,
+                              tolerance_ms=args.tolerance_ms)
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(merged, f)
+    print(f"trace_assemble: {len(merged['traceEvents'])} events from "
+          f"{len(dumps)} process(es) -> {args.out} "
+          f"({report['flow_links']} cross-process flow link(s))")
+    for proc in report["processes"]:
+        print("  %-28s %6d events, correction %+.3f ms%s" % (
+            f"{proc['role']} pid {proc['orig_pid']}", proc["events"],
+            proc["correction_ms"],
+            "" if proc["aligned"] else " (no probe path: assumed synced)"))
+    if args.report:
+        with open(args.report, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+        print(f"trace_assemble: critical-path report -> {args.report}")
+    for kind in ("train", "serve"):
+        cp = report.get(kind)
+        if not cp:
+            continue
+        segs = ", ".join(f"{k} {v['pct']}%"
+                         for k, v in sorted(cp["segments"].items()))
+        head = (f"{cp['steps']} step(s)" if kind == "train"
+                else f"{cp['requests']} request(s)")
+        print(f"  critical path [{kind}]: {head}: {segs}")
+    if report["violations"]:
+        print(f"trace_assemble: {len(report['violations'])} chain "
+              "violation(s) after offset correction", file=sys.stderr)
+        for v in report["violations"][:5]:
+            print(f"  {v}", file=sys.stderr)
+        if args.strict:
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
