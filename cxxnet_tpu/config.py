@@ -643,6 +643,79 @@ def parse_data_service_config(cfg: ConfigPairs) -> DataServiceConfig:
     return dc
 
 
+# -- model health -------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class HealthConfig:
+    """The ``health_*`` knob set (doc/tasks.md "Model health"). One
+    validated namespace, same contract as ``serve_*`` / ``telemetry_*``:
+    a typo'd key raises instead of silently training unobserved.
+    ``health = 1`` makes the train step compute compact per-layer
+    numerics IN-TRACE (grad RMS/abs-max/finite-fraction, param RMS,
+    update-to-weight ratio, activation abs-max / dead-ReLU fraction /
+    BN batch-variance floor) that ride the step outputs and host-sync
+    only every ``health_interval`` steps; ``health = 0`` (default) adds
+    ZERO ops to the jaxpr and zero host syncs — the off path is
+    byte-identical to a build that never heard of this namespace
+    (pinned by tests/test_modelhealth.py)."""
+    enabled: int = 0        # health: 1 = in-step model-health probe
+    interval: int = 0       # health_interval: sync cadence in steps
+    #                         (0 = follow sentinel_interval, default 8)
+    window: int = 3         # health_window: consecutive bad syncs
+    #                         before a detector emits health_advice
+    dead_frac: float = 0.9  # health_dead_frac: dead-ReLU threshold
+    bn_var_floor: float = 1e-8  # health_bn_var_floor: BN collapse
+    ratio_min: float = 1e-8     # health_ratio_min: update/weight band
+    ratio_max: float = 0.1      # health_ratio_max: update/weight band
+
+
+def parse_health_config(cfg: ConfigPairs) -> HealthConfig:
+    """Collect/validate the ``health`` / ``health_*`` keys (last
+    occurrence wins; unknown keys in the namespace fail fast)."""
+    known = {
+        "health": ("enabled", int),
+        "health_interval": ("interval", int),
+        "health_window": ("window", int),
+        "health_dead_frac": ("dead_frac", float),
+        "health_bn_var_floor": ("bn_var_floor", float),
+        "health_ratio_min": ("ratio_min", float),
+        "health_ratio_max": ("ratio_max", float),
+    }
+    vals = {}
+    for name, val in cfg:
+        if name == "health" or name.startswith("health_"):
+            if name not in known:
+                raise ConfigError(
+                    f"unknown health setting {name!r}; valid keys: "
+                    + ", ".join(sorted(known)))
+            field, conv = known[name]
+            try:
+                vals[field] = conv(val)
+            except ValueError as e:
+                raise ConfigError(f"bad {name} value {val!r}: {e}")
+    hc = HealthConfig(**vals)
+    if hc.enabled not in (0, 1):
+        raise ConfigError(f"health must be 0 or 1, got {hc.enabled}")
+    if hc.interval < 0:
+        raise ConfigError(
+            f"health_interval must be >= 0 (0 = sentinel_interval), "
+            f"got {hc.interval}")
+    if hc.window < 1:
+        raise ConfigError(
+            f"health_window must be >= 1, got {hc.window}")
+    if not 0.0 < hc.dead_frac <= 1.0:
+        raise ConfigError(
+            f"health_dead_frac must be in (0, 1], got {hc.dead_frac}")
+    if hc.bn_var_floor < 0:
+        raise ConfigError(
+            f"health_bn_var_floor must be >= 0, got {hc.bn_var_floor}")
+    if not 0.0 <= hc.ratio_min < hc.ratio_max:
+        raise ConfigError(
+            "health_ratio_min must be >= 0 and < health_ratio_max, got "
+            f"{hc.ratio_min}/{hc.ratio_max}")
+    return hc
+
+
 # -- IO retry policy ----------------------------------------------------------
 
 @dataclasses.dataclass(frozen=True)

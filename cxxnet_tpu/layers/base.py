@@ -170,6 +170,13 @@ class ApplyCtx:
     # nothing; the pad/slice pair transposes exactly under autodiff),
     # params/checkpoints keep the canonical shape. None = no pad.
     cin_pad: Optional[int] = None
+    # model-health activation sink (telemetry/modelhealth.py): bound by
+    # Network.apply when ``health = 1`` — the standard per-layer taps
+    # (abs-max, dead-ReLU fraction, BN batch-variance floor) are written
+    # by Network.apply itself; a plugin layer may add its OWN fp32
+    # scalar stats under its layer name. None = health off (the default
+    # path pays one attribute check, nothing more).
+    health_sink: Optional[Dict[str, Any]] = None
 
 
 class Layer:

@@ -164,6 +164,12 @@ class LearnTask:
         # one-step transient spikes too).
         self.sentinel_interval = max(1, int(gp("sentinel_interval", "8")))
         self.sentinel: Optional[TrainingSentinel] = None
+        # model-health probe (doc/tasks.md "Model health"): built per
+        # _train_rounds when the trainer carries in-step health stats;
+        # syncs on its own (or the sentinel's) interval and feeds the
+        # sentinel's grad_norm parameter
+        self.health_probe = None
+        self._health_every = self.sentinel_interval
         # -- elastic training (doc/tasks.md "Elastic training") -----------
         # elastic_dir set = the train task runs as an elastic worker:
         # membership + heartbeats + generation agreement, topology-
@@ -737,6 +743,24 @@ class LearnTask:
                   flush=True)
 
     # -- resilience hooks --------------------------------------------------
+    def _health_sync(self, tr, r: int):
+        """Amortized model-health sync (THE one host sync per
+        ``health_interval``): fan the in-trace stat tree out through
+        the probe (metrics + detectors), and on an fp16 scaler-overflow
+        ONSET run the one-shot grad-provenance walk so the advice event
+        names the overflowing layer."""
+        hp = self.health_probe
+        info = hp.ingest(tr.last_health_handle, round_no=r,
+                         step=tr._step_count)
+        if info is not None and info.get("overflow_onset"):
+            from .telemetry.modelhealth import diagnose_nonfinite
+            try:
+                prov = diagnose_nonfinite(tr)
+            except Exception as e:  # diagnosis must never block training
+                prov = f"diagnosis-failed:{type(e).__name__}"
+            hp.note_overflow_advice(r, tr._step_count, prov)
+        return info
+
     def _sentinel_step(self, tr, r: int, losses=None,
                        force: bool = False) -> None:
         """Feed the sentinel after a dispatched update; on an anomaly,
@@ -747,25 +771,53 @@ class LearnTask:
         The ``sentinel_interval`` gate amortizes the host-device sync
         for plain AND chain dispatches; ``force=True`` (end of round,
         just before the checkpoint write) bypasses it so a NaN that
-        landed between ticks can never be checkpointed."""
+        landed between ticks can never be checkpointed. The
+        model-health probe syncs here too (its own ``health_interval``
+        modulus on the same tick counter) and its in-trace global grad
+        norm finally feeds the sentinel's ``grad_norm`` parameter —
+        except on fp16 overflow steps, which the loss scaler already
+        handled and must not read as hard anomalies."""
         sentinel = self.sentinel
-        if sentinel is None:
+        hp = self.health_probe
+        if sentinel is None and hp is None:
             return
         self._sentinel_tick += 1
+        if hp is not None \
+                and self._sentinel_tick % self._health_every == 0:
+            self._health_sync(tr, r)
+        if sentinel is None:
+            return
         if not force and self._sentinel_tick % self.sentinel_interval:
             return
         if losses is None:
             vals = [tr.last_loss]
         else:          # chain dispatch: the per-step loss vector, host-side
             vals = [float(v) for v in np.asarray(losses).ravel()]
+        gn = hp.last_grad_norm if hp is not None else None
         reason = None
         for v in vals:
-            reason = sentinel.observe(v)
+            reason = sentinel.observe(v, grad_norm=gn)
             if reason:
                 break
         if reason is None:
             return
-        LEDGER.event("sentinel_trip", round=r, reason=reason)
+        counters.inc("sentinel.anomalies")
+        # one-shot NaN provenance: name the first non-finite layer
+        # (param -> activation -> grad walk) BEFORE the rollback wipes
+        # the poisoned state — the sentinel record, the ledger events,
+        # and the round log all carry it
+        prov = None
+        if tr.health_on:
+            from .telemetry.modelhealth import diagnose_nonfinite
+            try:
+                prov = diagnose_nonfinite(tr)
+            except Exception as e:        # diagnosis must never block recovery
+                prov = f"diagnosis-failed:{type(e).__name__}"
+            if prov:
+                sentinel.annotate_last(prov)
+                reason = f"{reason} [{prov}]"
+        LEDGER.event("sentinel_trip", round=r, reason=reason,
+                     provenance=prov)
         # drain any in-flight async checkpoint write BEFORE scanning —
         # a failed one degrades (counted) exactly like a sync failure,
         # and the scan must not race a live writer. No tmp sweep here:
@@ -798,9 +850,14 @@ class LearnTask:
         tr.optimizer.lr_scale = min(scale_before, tr.optimizer.lr_scale) \
             * self.lr_backoff
         sentinel.reset_window()
+        if hp is not None:
+            # the probe's last reading describes the poisoned step; a
+            # stale NaN grad norm must not re-trip against restored
+            # params
+            hp.reset_after_rollback()
         counters.inc("sentinel.rollbacks")
         LEDGER.event("rollback", round=r, to_round=r0, path=path,
-                     reason=reason,
+                     reason=reason, provenance=prov,
                      lr_scale=float(tr.optimizer.lr_scale))
         if not self.silent:
             print(f"sentinel: {reason}; rolled back to round {r0} "
@@ -894,6 +951,18 @@ class LearnTask:
                  if self.telemetry_cfg.steptime and not self.test_io
                  else None)
         self._steptime_probe = probe
+        # model-health probe: consumes the in-trace per-layer stat tree
+        # the trainer's step returns when health=1, syncing on its own
+        # interval (default: the sentinel's) — metrics + detectors +
+        # the sentinel's grad_norm (doc/tasks.md "Model health")
+        self.health_probe = None
+        if tr.health_on and not self.test_io:
+            from .telemetry.modelhealth import HealthProbe
+            self.health_probe = HealthProbe(
+                tr.health_cfg, fp16=tr.optimizer.fp16,
+                silent=bool(self.silent))
+            self._health_every = (tr.health_cfg.interval
+                                  or self.sentinel_interval)
         profiler = self.telemetry.profiler
         chain = self.train_chain if self.train_chain > 1 else 0
         if chain and (tr.mesh.pipeline_parallel > 1
@@ -1032,6 +1101,11 @@ class LearnTask:
             if probe is not None:
                 # step-time breakdown + input-/compute-bound verdict
                 line += probe.report_fragment()
+            if self.health_probe is not None:
+                # grad-norm / dead-ReLU / loss-scale one-liner + the
+                # per-round model_health ledger event
+                line += self.health_probe.report_fragment()
+                self.health_probe.round_event(r)
             # fleet housekeeping (snapshot push, round_end ledger event,
             # recompile-storm feed) + per-host medians / straggler
             # verdicts on the aggregating host

@@ -34,6 +34,9 @@ class ForwardResult:
     state: NetState                       # updated layer state (BN stats, ...)
     nodes: Optional[Dict[str, jax.Array]]  # node name -> value (if captured)
     out: jax.Array                        # value of the last node (predictions)
+    # per-layer activation health stats (model-health probe; None unless
+    # apply(health=True)): {layer: {"absmax", "zero_frac"?, "bn_var_min"?}}
+    health: Optional[Dict[str, Any]] = None
 
 
 class Network:
@@ -177,7 +180,8 @@ class Network:
               data_axis: Optional[str] = None,
               label_slices: Optional[Dict[Tuple[int, int],
                                           jax.Array]] = None,
-              compute_dtype: Optional[Any] = None) -> ForwardResult:
+              compute_dtype: Optional[Any] = None,
+              health: bool = False) -> ForwardResult:
         """One forward pass. ``data`` is NHWC (batch, y, x, c) or flat
         (batch,1,1,n); ``label`` is (batch, label_width); ``mask`` is (batch,)
         marking real rows (None = all real). ``label_slices`` maps a loss
@@ -187,7 +191,12 @@ class Network:
         token-aligned columns of every slice). ``compute_dtype`` overrides
         the config policy's compute dtype for this call — the serve
         engine's per-engine ``dtype`` option (a checkpoint trained fp32
-        can serve bf16 and vice versa; fp32 masters make the cast safe)."""
+        can serve bf16 and vice versa; fp32 masters make the cast safe).
+        ``health=True`` taps per-layer activation stats (abs-max,
+        dead-ReLU zero fraction, BN batch-variance floor) into
+        ``result.health`` through the ``ApplyCtx.health_sink`` hook —
+        the model-health probe's in-trace activation view
+        (telemetry/modelhealth.py); False adds zero ops."""
         g = self.graph
         batch = data.shape[0]
         nodes: List[Optional[jax.Array]] = [None] * g.num_nodes
@@ -201,13 +210,18 @@ class Network:
         new_state: NetState = dict(state)
         cdt = self.compute_dtype if compute_dtype is None else compute_dtype
         fused_now = self._fused_now()
+        health_sink: Optional[Dict[str, Any]] = {} if health else None
         total_loss = jnp.zeros((), jnp.float32)
         for li, (spec, layer) in enumerate(zip(g.layers, self.layers)):
             if li in self._act_folded:
                 # relu folded into its producer's epilogue
                 # (graph.act_fusion_plan): the producer already applied
-                # it, so this layer is a pass-through
+                # it, so this layer is a pass-through — the tap still
+                # fires (the node holds the post-activation value, so
+                # the dead-ReLU fraction stays meaningful)
                 nodes[spec.nindex_out[0]] = nodes[spec.nindex_in[0]]
+                if health_sink is not None:
+                    self._health_tap(health_sink, spec, nodes, train)
                 continue
             ctx = ApplyCtx(train=train, rng=jax.random.fold_in(rng, li),
                            compute_dtype=cdt,
@@ -216,7 +230,8 @@ class Network:
                            fused_spmd=self.fused_spmd if fused_now
                            else None,
                            fuse_act=self._fuse_act.get(li),
-                           cin_pad=self._cin_pad.get(li))
+                           cin_pad=self._cin_pad.get(li),
+                           health_sink=health_sink)
             inputs = [nodes[ni] for ni in spec.nindex_in]
             lparams = params.get(layer.name, {})
             lstate = new_state.get(layer.name, {})
@@ -244,6 +259,8 @@ class Network:
                     total_loss = total_loss + lstate_out["_aux_loss"]
             for ni, out in zip(spec.nindex_out, outputs):
                 nodes[ni] = out
+            if health_sink is not None:
+                self._health_tap(health_sink, spec, nodes, train)
             if layer.is_loss and (label is not None
                                   or label_slices is not None):
                 a, b = g.label_slice(layer.target)
@@ -259,7 +276,38 @@ class Network:
         # req = top node, nnet_impl-inl.hpp:203-216)
         out = nodes[g.layers[-1].nindex_out[0]] if g.layers else data
         return ForwardResult(loss=total_loss, state=new_state,
-                             nodes=node_map, out=out)
+                             nodes=node_map, out=out, health=health_sink)
+
+    #: layer types whose exact-zero output fraction IS the dead-unit
+    #: signal (a relu that emits 0 for every batch row is a dead unit;
+    #: sustained growth of that fraction is the classic silent-failure
+    #: mode the OPT run logs watched per layer)
+    _HEALTH_DEAD_TYPES = frozenset({"relu"})
+
+    def _health_tap(self, sink: Dict[str, Any], spec, nodes,
+                    train: bool) -> None:
+        """Per-layer activation stats for the model-health probe (all
+        fp32 scalars, computed in-trace): abs-max for every layer,
+        exact-zero output fraction for relus (dead-ReLU signal), and
+        the minimum per-channel batch variance of a batch_norm layer's
+        INPUT (train only — the quantity whose collapse toward 0 makes
+        the BN rsqrt amplify noise). Padding rows are included in the
+        batch statistics — a per-epoch tail effect too small to gate
+        on. A plugin layer may have added its own entry via
+        ``ctx.health_sink``; the standard taps win on a name clash."""
+        out = nodes[spec.nindex_out[0]]
+        x32 = out.astype(jnp.float32)
+        ent: Dict[str, jax.Array] = {"absmax": jnp.max(jnp.abs(x32))}
+        if spec.type in self._HEALTH_DEAD_TYPES:
+            ent["zero_frac"] = jnp.mean((x32 == 0.0).astype(jnp.float32))
+        if train and spec.type in ("batch_norm", "batch_norm_no_ma"):
+            xin = nodes[spec.nindex_in[0]].astype(jnp.float32)
+            axes = tuple(range(xin.ndim - 1))
+            var = jnp.maximum(
+                jnp.mean(jnp.square(xin), axes)
+                - jnp.square(jnp.mean(xin, axes)), 0.0)
+            ent["bn_var_min"] = jnp.min(var)
+        sink[spec.name] = ent
 
     # -- pipeline staging (config-driven pp, parallel/pipeline.py) ---------
     def stage_partition(self, n_stages: int) -> List[Tuple[int, int]]:

@@ -269,6 +269,39 @@ class Optimizer:
             out[tag] = (lr * self.lr_scale, mom)
         return out
 
+    # -- model-health stats (telemetry/modelhealth.py) ---------------------
+    def health_update_stats(self, params_before, params_after,
+                            eps: float = 1e-12):
+        """Per-leaf update-to-weight RMS ratio of the APPLIED delta —
+        ``rms(w_new - w_old) / rms(w_old)``, keyed "layer/param". The
+        optimizer owns the semantics: an fp16 overflow skip or a
+        non-boundary accumulation step applied nothing, so the ratio is
+        exactly 0 there (the probe treats 0 as "skipped", not
+        "vanished"). Healthy SGD-family training sits around 1e-4..1e-2;
+        a sustained excursion out of the configured band is the
+        update-dynamics anomaly the PaLM/OPT-style run logs watch.
+        Pure jnp — called inside the compiled train step."""
+        pairs, _ = jax.tree_util.tree_flatten_with_path(params_before)
+        after = jax.tree_util.tree_leaves(params_after)
+        out = {}
+        for (path, b), a in zip(pairs, after):
+            b32 = b.astype(jnp.float32)
+            d = a.astype(jnp.float32) - b32
+            key = "/".join(str(getattr(p, "key", p)) for p in path)
+            out[key] = {"ratio": jnp.sqrt(jnp.mean(jnp.square(d)))
+                        / (jnp.sqrt(jnp.mean(jnp.square(b32))) + eps)}
+        return out
+
+    def health_scaler_stats(self, opt_state):
+        """fp16 loss-scaler numerics for the health tree: the post-step
+        scale (halvings between syncs show as a scale drop). Empty for
+        bf16/fp32 policies — the health-off/fp32 jaxpr carries nothing.
+        Pure jnp — called inside the compiled train step."""
+        if isinstance(opt_state, dict) and "_mp" in opt_state:
+            return {"loss_scale":
+                    opt_state["_mp"]["scale"].astype(jnp.float32)}
+        return {}
+
     # -- update ------------------------------------------------------------
     def update(self, params, grads, opt_state, sched: Dict[str, Any],
                finite_axes: Tuple[str, ...] = ()):

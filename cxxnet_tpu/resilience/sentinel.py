@@ -79,6 +79,15 @@ class TrainingSentinel:
         self.anomalies.append(reason)
         return reason
 
+    def annotate_last(self, detail: str) -> None:
+        """Append detail to the most recent anomaly record — the round
+        loop attaches the model-health NaN provenance
+        (``layer=conv3 kind=grad``, telemetry/modelhealth.py) here
+        after the fact, since the one-shot diagnostic walk runs only
+        once an observation has already flagged the step."""
+        if detail and self.anomalies:
+            self.anomalies[-1] += f" [{detail}]"
+
     # -- rollback accounting ---------------------------------------------
     def record_rollback(self, to_round: int, reason: str) -> None:
         """Account one rollback; raises :class:`SentinelAbort` when the

@@ -71,6 +71,10 @@ KNOWN_EVENTS = (
     # input-data service (data_service/reader.py, client.py)
     "dataservice_start", "dataservice_stop", "dataservice_rebalance",
     "dataservice_degrade",
+    # model health (telemetry/modelhealth.py): per-round stat summary +
+    # deduped training-dynamics advice (dead-ReLU growth, BN variance
+    # collapse, out-of-band update ratios, fp16 scaler overflow)
+    "model_health", "health_advice",
 )
 
 

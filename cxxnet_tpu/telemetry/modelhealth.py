@@ -1,0 +1,546 @@
+"""Model-health observability: in-trace per-layer numerics, NaN
+provenance, and training-dynamics detectors.
+
+The infrastructure layers already explain *machine* trouble (step-time
+breakdown, fleet ledger, distributed traces); this module makes a run
+explain its own *numerics* — the per-layer grad/update/activation
+statistics the large-run practice the sentinel cites (the PaLM and OPT
+run logs) treats as the primary divergence diagnostic, and the
+cxxnet-era monitor layers (Caffe ``debug_info``, MXNet ``Monitor``)
+shipped as a matter of course. Three pieces:
+
+* **In-trace stat builders** (:func:`step_health` and friends) — pure
+  jnp functions the trainer's step bodies call when ``health = 1``:
+  per-param-leaf grad RMS / abs-max / finite-fraction (unscaled under
+  the fp16 loss scaler), param RMS, update-to-weight RMS ratio of the
+  optimizer's APPLIED delta, the global gradient norm, and the
+  per-layer activation stats ``Network.apply`` taps through the
+  ``ApplyCtx`` hook (abs-max, dead-ReLU zero fraction, BN
+  batch-variance floor). Everything lands in one small fp32 pytree
+  (a few hundred scalars) riding the existing step outputs — no extra
+  dispatch, no host sync in the step itself.
+* **:class:`HealthProbe`** — the host-side consumer: syncs the tree at
+  most once per ``health_interval`` steps (the steptime.py
+  amortization), fans values out to labeled ``cxxnet_health_*``
+  registry metrics, runs the windowed training-dynamics detectors
+  (sustained dead-ReLU growth, BN variance collapse, out-of-band
+  update ratios — PR-7 ``anomaly.py`` style: a pure
+  :class:`WindowRule` inside a deduping stateful shell emitting
+  ``health_advice`` ledger events), and feeds the sentinel's
+  ``grad_norm`` parameter.
+* **:func:`diagnose_nonfinite`** — the one-shot NaN-provenance walk:
+  on a non-finite loss (or a scaler overflow) it checks params, then a
+  diagnostic forward's activations, then a diagnostic backward's
+  gradients, each in layer topological order, and names the FIRST
+  non-finite site as ``layer=conv3 kind=grad leaf=wmat`` — the string
+  the sentinel anomaly, the rollback ledger event, and the round log
+  all carry, so a rollback says *which layer* poisoned the step.
+
+Overhead contract (doc/tasks.md "Model health"): ``health = 0`` adds
+zero ops to the compiled step and zero host syncs (the off jaxpr is
+byte-identical to a pre-health build); ``health = 1`` adds one small
+fp32 stat tree per step, one batch of stash references for the
+diagnostic walk, and <= 1 host sync per interval — and never changes
+the training math (losses/params bit-identical on vs off, pinned by
+tests/test_modelhealth.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .ledger import LEDGER
+from .registry import REGISTRY, MetricRegistry
+
+
+# -- in-trace stat builders (pure jnp; called inside the compiled step) -------
+
+def _leaf_key(path) -> str:
+    """tree_flatten_with_path key path -> "layer/sub/leaf"."""
+    return "/".join(str(getattr(p, "key", p)) for p in path)
+
+
+def _rms(x32: jax.Array) -> jax.Array:
+    return jnp.sqrt(jnp.mean(jnp.square(x32)))
+
+
+def grad_stats(grads, inv_scale=None) -> Dict[str, Dict[str, jax.Array]]:
+    """Per-leaf gradient numerics: RMS, abs-max, finite fraction — fp32
+    scalars keyed "layer/param". ``inv_scale`` unscales fp16
+    loss-scaled gradients so the exported numbers are the TRUE grads
+    (finiteness is scale-invariant; magnitudes are not)."""
+    pairs, _ = jax.tree_util.tree_flatten_with_path(grads)
+    out: Dict[str, Dict[str, jax.Array]] = {}
+    for path, g in pairs:
+        g32 = g.astype(jnp.float32)
+        if inv_scale is not None:
+            g32 = g32 * inv_scale
+        out[_leaf_key(path)] = {
+            "rms": _rms(g32),
+            "absmax": jnp.max(jnp.abs(g32)),
+            "finite_frac": jnp.mean(jnp.isfinite(g32).astype(jnp.float32)),
+        }
+    return out
+
+
+def param_stats(params) -> Dict[str, Dict[str, jax.Array]]:
+    """Per-leaf parameter RMS (fp32 masters), keyed "layer/param"."""
+    pairs, _ = jax.tree_util.tree_flatten_with_path(params)
+    return {_leaf_key(path): {"rms": _rms(p.astype(jnp.float32))}
+            for path, p in pairs}
+
+
+def global_grad_norm(grads, inv_scale=None) -> Tuple[jax.Array, jax.Array]:
+    """(global L2 norm, all-finite flag as fp32 1/0) over every gradient
+    leaf — the number the sentinel's ``grad_norm`` parameter has waited
+    for since PR 3. NaN/Inf anywhere makes the norm non-finite, which
+    is exactly the hard-anomaly signal."""
+    ss = jnp.zeros((), jnp.float32)
+    finite = jnp.bool_(True)
+    for g in jax.tree_util.tree_leaves(grads):
+        g32 = g.astype(jnp.float32)
+        if inv_scale is not None:
+            g32 = g32 * inv_scale
+        ss = ss + jnp.sum(jnp.square(g32))
+        finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g32)))
+    return jnp.sqrt(ss), finite.astype(jnp.float32)
+
+
+def step_health(grads, params_before, params_after, optimizer,
+                opt_state_in, opt_state_out,
+                act: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Assemble one step's health pytree (all fp32 scalars) from the
+    pieces the step body already holds: raw grads (pre ``_prep_grad``,
+    so a NaN the optimizer would silently zero still shows), the param
+    masters around the apply (the optimizer's update-ratio view of its
+    APPLIED delta — fp16 skipped steps and non-boundary accumulation
+    steps yield exact 0), and the activation sink ``Network.apply``
+    filled. Under fp16 the scaler's current scale (read from the INPUT
+    opt state — the scale this step's grads carry) unscales the grad
+    stats and the post-step scale is exported."""
+    mp = opt_state_in.get("_mp") if isinstance(opt_state_in, dict) else None
+    inv = (1.0 / mp["scale"]) if mp is not None else None
+    gnorm, finite = global_grad_norm(grads, inv)
+    health: Dict[str, Any] = {
+        "grad_norm": gnorm,
+        "grad_finite": finite,
+        "grad": grad_stats(grads, inv),
+        "param": param_stats(params_after),
+        "update": optimizer.health_update_stats(params_before,
+                                                params_after),
+        "act": act or {},
+    }
+    health.update(optimizer.health_scaler_stats(opt_state_out))
+    return health
+
+
+def reduce_island(act: Dict[str, Dict[str, jax.Array]],
+                  axes) -> Dict[str, Dict[str, jax.Array]]:
+    """Make shard-local activation stats fleet-consistent inside a
+    manual shard_map step (the sp path): abs-max -> pmax, ``*_min`` ->
+    pmin, fractions/means -> pmean (exact for equal-size shards). The
+    GSPMD (std) path needs none of this — its stats are computed on the
+    global logical arrays by construction."""
+    out: Dict[str, Dict[str, jax.Array]] = {}
+    for layer, stats in act.items():
+        ent = {}
+        for k, v in stats.items():
+            if k.endswith("absmax"):
+                ent[k] = jax.lax.pmax(v, axes)
+            elif k.endswith("_min"):
+                ent[k] = jax.lax.pmin(v, axes)
+            else:
+                ent[k] = jax.lax.pmean(v, axes)
+        out[layer] = ent
+    return out
+
+
+# -- NaN provenance ------------------------------------------------------------
+
+def _diag_run(net):
+    """The one-shot diagnostic apply body (pure; traced under jit by
+    :func:`diagnose_nonfinite`): forward with every node captured plus
+    a backward of the (fp16: scaler-scaled) loss, reproducing exactly
+    the numerics of the step that tripped. The live loss scale arrives
+    as the traced runtime argument ``s``."""
+    from ..trainer import _fold_input
+
+    def run(params, net_state, data, label, mask, extra, rng, s):
+        d = _fold_input(data, net)
+
+        def loss_fn(p):
+            res = net.apply(p, net_state, d, label, mask,
+                            extra_data=extra, rng=rng, train=True,
+                            capture_nodes=True)
+            return res.loss * s, res.nodes
+        (sloss, nodes), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        return nodes, sloss / s, grads
+    return run
+
+
+def _first_nonfinite_leaf(tree) -> Optional[str]:
+    import numpy as np
+    pairs, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in pairs:
+        if not np.all(np.isfinite(np.asarray(leaf))):
+            return _leaf_key(path)
+    return None
+
+
+def diagnose_nonfinite(trainer) -> Optional[str]:
+    """First-non-finite provenance: walk the model in layer topological
+    order and name where the poison entered — ``layer=<name>
+    kind=param|activation|grad|loss [leaf=...|node=...]``. Three
+    passes, cheapest first:
+
+    1. **params** — the usual post-mortem state: a poisoned step has
+       already written NaN into some layer's masters. Needs no batch,
+       so it works for every step family (std/sp/chain).
+    2. **activations** — params finite but the loss blew up: re-run the
+       forward on the stashed last batch with every node captured; the
+       first layer whose output is non-finite is the overflow site.
+    3. **grads** — the fp16 scaler path (loss finite, apply skipped):
+       re-run the backward with the CURRENT loss scale; the first
+       non-finite gradient leaf in layer order names the layer.
+
+    Passes 2/3 need the batch stash the trainer keeps when health is on
+    (std path only — sp/pp and chain dispatches fall back to pass 1).
+    One-shot by design: the diagnostic apply jit-compiles per call and
+    fetches full activations — pennies next to the rollback it
+    annotates, never on the steady-state path."""
+    import numpy as np
+    g, net = trainer.graph, trainer.net
+    params_host = jax.device_get(trainer.mesh.gather(trainer.params))
+    for spec, layer in zip(g.layers, net.layers):
+        if spec.is_shared:
+            continue
+        lp = params_host.get(layer.name)
+        if not lp:
+            continue
+        leaf = _first_nonfinite_leaf(lp)
+        if leaf is not None:
+            return f"layer={layer.name} kind=param leaf={leaf}"
+    stash = getattr(trainer, "_health_batch", None)
+    if stash is None:
+        return None
+    data, label, mask, extra, rng = stash
+    opt = trainer.opt_state
+    scale = (opt["_mp"]["scale"] if isinstance(opt, dict) and "_mp" in opt
+             else jnp.float32(1.0))
+    nodes, loss, grads = jax.jit(_diag_run(net))(
+        trainer.params, trainer.net_state, data, label, mask,
+        tuple(extra), rng, scale)
+    nodes_host = jax.device_get(nodes)
+    for spec in g.layers:
+        for ni in spec.nindex_out:
+            v = nodes_host.get(g.node_names[ni])
+            if v is not None and not np.all(np.isfinite(v)):
+                return (f"layer={spec.name} kind=activation "
+                        f"node={g.node_names[ni]}")
+    grads_host = jax.device_get(grads)
+    for spec, layer in zip(g.layers, net.layers):
+        if spec.is_shared:
+            continue
+        lg = grads_host.get(layer.name)
+        if not lg:
+            continue
+        leaf = _first_nonfinite_leaf(lg)
+        if leaf is not None:
+            return f"layer={layer.name} kind=grad leaf={leaf}"
+    if not np.isfinite(float(np.asarray(loss))):
+        return "layer=? kind=loss"
+    return None
+
+
+# -- training-dynamics detectors ----------------------------------------------
+
+class WindowRule:
+    """Pure windowed-onset rule (the PR-7 detector shape): a key fires
+    once after ``window`` CONSECUTIVE bad observations, stays silent
+    while the condition persists, and re-arms after the first good
+    observation — so a persistently dead layer emits one advice event
+    per onset, not one per sync. ``observe(key, None)`` marks an
+    observation that is neither good nor bad (e.g. an update ratio of
+    exactly 0 on a skipped step): the streak neither advances nor
+    resets."""
+
+    def __init__(self, window: int):
+        self.window = max(1, int(window))
+        self._streak: Dict[Any, int] = {}
+        self._fired: set = set()
+
+    def observe(self, key, bad: Optional[bool]) -> bool:
+        if bad is None:
+            return False
+        if not bad:
+            self._streak[key] = 0
+            self._fired.discard(key)
+            return False
+        s = self._streak.get(key, 0) + 1
+        self._streak[key] = s
+        if s >= self.window and key not in self._fired:
+            self._fired.add(key)
+            return True
+        return False
+
+
+class HealthProbe:
+    """Host-side consumer of the in-trace health tree: amortized sync,
+    registry fan-out, windowed detectors, round-log fragment, and the
+    per-round ``model_health`` ledger event. Owned by the round loop
+    (main.py) exactly like the step-time probe; not thread-safe."""
+
+    def __init__(self, cfg, fp16: bool = False,
+                 registry: Optional[MetricRegistry] = None,
+                 silent: bool = False):
+        self.cfg = cfg
+        self.fp16 = bool(fp16)
+        self.silent = bool(silent)
+        self.syncs = 0
+        self.overflows = 0
+        self.advice_events = 0
+        self.last: Optional[Dict[str, Any]] = None
+        self.last_step: Optional[int] = None
+        #: grad norm to feed TrainingSentinel.observe — None until the
+        #: first sync, and None on fp16 overflow steps (the scaler
+        #: already handled those; a routine skip must not read as a
+        #: hard anomaly)
+        self.last_grad_norm: Optional[float] = None
+        self._last_overflow = False
+        self._dead_rule = WindowRule(cfg.window)
+        self._bn_rule = WindowRule(cfg.window)
+        self._ratio_rule = WindowRule(cfg.window)
+        reg = registry or REGISTRY
+        lp = ("layer", "param")
+        self._g_grad_rms = reg.gauge(
+            "cxxnet_health_grad_rms",
+            "Per-leaf gradient RMS (unscaled)", labels=lp)
+        self._g_grad_absmax = reg.gauge(
+            "cxxnet_health_grad_absmax",
+            "Per-leaf gradient abs-max (unscaled)", labels=lp)
+        self._g_grad_finite = reg.gauge(
+            "cxxnet_health_grad_finite_frac",
+            "Per-leaf fraction of finite gradient entries", labels=lp)
+        self._g_param_rms = reg.gauge(
+            "cxxnet_health_param_rms",
+            "Per-leaf parameter RMS (fp32 masters)", labels=lp)
+        self._g_update_ratio = reg.gauge(
+            "cxxnet_health_update_ratio",
+            "Per-leaf update-to-weight RMS ratio of the applied delta",
+            labels=lp)
+        self._g_act_absmax = reg.gauge(
+            "cxxnet_health_act_absmax",
+            "Per-layer activation abs-max", labels=("layer",))
+        self._g_dead = reg.gauge(
+            "cxxnet_health_dead_frac",
+            "Per-layer dead-ReLU (exact-zero) output fraction",
+            labels=("layer",))
+        self._g_bn_var = reg.gauge(
+            "cxxnet_health_bn_var_min",
+            "Per-layer minimum BN batch variance across channels",
+            labels=("layer",))
+        self._g_gnorm = reg.gauge(
+            "cxxnet_health_grad_norm",
+            "Global gradient L2 norm (unscaled)")
+        self._g_scale = reg.gauge(
+            "cxxnet_health_loss_scale",
+            "fp16 dynamic loss scale after the last synced step")
+        self._c_syncs = reg.counter(
+            "cxxnet_health_syncs_total",
+            "Host syncs taken by the model-health probe")
+        self._c_overflow = reg.counter(
+            "cxxnet_health_overflow_total",
+            "fp16 scaler-overflow (skipped-apply) steps seen at syncs")
+        self._c_advice = reg.counter(
+            "cxxnet_health_advice_total",
+            "Training-dynamics advice events emitted", labels=("kind",))
+
+    # -- feeding ---------------------------------------------------------
+    def ingest(self, tree, round_no: Optional[int] = None,
+               step: Optional[int] = None) -> Optional[Dict[str, Any]]:
+        """Sync the device health tree (THE one host sync the probe
+        takes per interval), fan out to metrics, run the detectors.
+        Returns the summary dict (also kept as ``self.last``)."""
+        if tree is None:
+            return None
+        host = jax.device_get(tree)
+        self.syncs += 1
+        self._c_syncs.inc()
+        self.last_step = step
+        gnorm = float(host.get("grad_norm", float("nan")))
+        finite = float(host.get("grad_finite", 1.0))
+        overflow = bool(self.fp16 and finite < 1.0)
+        onset = overflow and not self._last_overflow
+        self._last_overflow = overflow
+        if overflow:
+            self.overflows += 1
+            self._c_overflow.inc()
+        self._g_gnorm.set(gnorm)
+        ls = host.get("loss_scale")
+        if ls is not None:
+            self._g_scale.set(float(ls))
+        for key, st in host.get("grad", {}).items():
+            layer, _, param = key.partition("/")
+            self._g_grad_rms.labels(layer, param).set(float(st["rms"]))
+            self._g_grad_absmax.labels(layer, param).set(
+                float(st["absmax"]))
+            self._g_grad_finite.labels(layer, param).set(
+                float(st["finite_frac"]))
+        for key, st in host.get("param", {}).items():
+            layer, _, param = key.partition("/")
+            self._g_param_rms.labels(layer, param).set(float(st["rms"]))
+        ratio_max: Optional[Tuple[float, str]] = None
+        params_host = host.get("param", {})
+        for key, st in host.get("update", {}).items():
+            layer, _, param = key.partition("/")
+            r = float(st["ratio"])
+            self._g_update_ratio.labels(layer, param).set(r)
+            # the ratio's denominator is the leaf's weight RMS: a
+            # near-zero leaf (zero-init biases early in training) makes
+            # the ratio meaningless — skip it for BOTH the worst-of
+            # summary and the band detector
+            prms = float(params_host.get(key, {}).get("rms", 1.0))
+            if prms < 1e-6:
+                continue
+            if ratio_max is None or r > ratio_max[0]:
+                ratio_max = (r, key)
+            # a ratio of exactly 0 is a skipped apply (fp16 overflow,
+            # non-boundary accumulation step) — neither good nor bad
+            bad = None if (r == 0.0 or overflow) else \
+                not (self.cfg.ratio_min <= r <= self.cfg.ratio_max)
+            if self._ratio_rule.observe(key, bad):
+                self._advise("update_ratio", key, r, round_no, step)
+        dead_max: Optional[Tuple[float, str]] = None
+        bn_min: Optional[Tuple[float, str]] = None
+        act_max: Optional[Tuple[float, str]] = None
+        for layer, st in host.get("act", {}).items():
+            am = float(st["absmax"])
+            self._g_act_absmax.labels(layer).set(am)
+            if act_max is None or am > act_max[0]:
+                act_max = (am, layer)
+            if "zero_frac" in st:
+                zf = float(st["zero_frac"])
+                self._g_dead.labels(layer).set(zf)
+                if dead_max is None or zf > dead_max[0]:
+                    dead_max = (zf, layer)
+                if self._dead_rule.observe(layer,
+                                           zf >= self.cfg.dead_frac):
+                    self._advise("dead_relu", layer, zf, round_no, step)
+            if "bn_var_min" in st:
+                bv = float(st["bn_var_min"])
+                self._g_bn_var.labels(layer).set(bv)
+                if bn_min is None or bv < bn_min[0]:
+                    bn_min = (bv, layer)
+                if self._bn_rule.observe(layer,
+                                         bv <= self.cfg.bn_var_floor):
+                    self._advise("bn_collapse", layer, bv, round_no,
+                                 step)
+        self.last = {
+            "grad_norm": gnorm, "grad_finite": finite,
+            "overflow": overflow, "overflow_onset": onset,
+            "loss_scale": float(ls) if ls is not None else None,
+            "dead_max": dead_max, "bn_var_min": bn_min,
+            "update_ratio_max": ratio_max, "act_absmax": act_max,
+        }
+        self.last_grad_norm = None if overflow else gnorm
+        return self.last
+
+    def _advise(self, kind: str, layer: str, value: float,
+                round_no, step, **extra) -> None:
+        self.advice_events += 1
+        self._c_advice.labels(kind).inc()
+        LEDGER.event("health_advice", kind=kind, layer=layer,
+                     value=round(float(value), 8), round=round_no,
+                     step=step, **extra)
+        if not self.silent:
+            print(f"health: {kind} on {layer} (value={value:.4g}, "
+                  f"{self.cfg.window} consecutive syncs)", flush=True)
+
+    def reset_after_rollback(self) -> None:
+        """Drop step-local readings after a sentinel rollback: the
+        stale pre-rollback grad norm (possibly NaN) must not re-trip
+        the sentinel against the restored, healthy params — the exact
+        sibling of ``TrainingSentinel.reset_window``."""
+        self.last = None
+        self.last_grad_norm = None
+        self._last_overflow = False
+
+    def note_overflow_advice(self, round_no, step,
+                             provenance: Optional[str]) -> None:
+        """Ledger the fp16 scaler-overflow onset with its one-shot grad
+        provenance (called by the round loop, which owns the trainer
+        the diagnostic walk needs)."""
+        self._advise("scaler_overflow",
+                     (provenance or "?").replace("layer=", "", 1)
+                     .split(" ")[0],
+                     self.last.get("loss_scale") or 0.0
+                     if self.last else 0.0,
+                     round_no, step, provenance=provenance)
+
+    # -- reading ---------------------------------------------------------
+    def round_event(self, round_no: int) -> None:
+        """One compact ``model_health`` ledger event per round — the
+        grep-able trail tools/report.py renders as the "Model health"
+        section."""
+        if self.last is None:
+            return
+        f: Dict[str, Any] = {
+            "round": round_no, "step": self.last_step,
+            "grad_norm": self.last["grad_norm"],
+            "syncs": self.syncs, "overflows": self.overflows,
+        }
+        if self.last.get("loss_scale") is not None:
+            f["loss_scale"] = self.last["loss_scale"]
+        for field, key in (("dead_max", "dead_max"),
+                           ("bn_var_min", "bn_var_min"),
+                           ("update_ratio_max", "update_ratio_max"),
+                           ("act_absmax", "act_absmax")):
+            v = self.last.get(key)
+            if v is not None:
+                f[field] = round(v[0], 8)
+                f[field + "_layer"] = v[1]
+        LEDGER.event("model_health", **f)
+
+    def report_fragment(self) -> str:
+        """Round-log fragment, same ``\\tkey:value`` dialect as the
+        metric line."""
+        if self.last is None:
+            return ""
+        out = "\tgrad_norm:%.4g" % self.last["grad_norm"]
+        if self.last.get("dead_max") is not None:
+            out += "\tdead_max:%.2f" % self.last["dead_max"][0]
+        if self.last.get("loss_scale") is not None:
+            out += "\tloss_scale:%g" % self.last["loss_scale"]
+        return out
+
+
+# -- offline layer report (tools/ckpt_health.py) -------------------------------
+
+def layer_report(params, state=None) -> List[Dict[str, Any]]:
+    """Host-side per-leaf health rows for a checkpoint's param (and
+    optionally state) trees — the offline sibling of the in-trace
+    stats, shared by tools/ckpt_health.py so online and offline numbers
+    are computed by one definition."""
+    import numpy as np
+    rows: List[Dict[str, Any]] = []
+
+    def walk(tree, kind):
+        pairs, _ = jax.tree_util.tree_flatten_with_path(tree)
+        for path, leaf in pairs:
+            a = np.asarray(leaf, dtype=np.float64)
+            n = a.size or 1
+            rows.append({
+                "leaf": _leaf_key(path), "kind": kind,
+                "shape": tuple(np.asarray(leaf).shape),
+                "rms": float(np.sqrt(np.mean(np.square(a)))),
+                "absmax": float(np.max(np.abs(a))) if a.size else 0.0,
+                "finite_frac": float(np.isfinite(a).sum() / n),
+            })
+    walk(params, "param")
+    if state:
+        walk(state, "state")
+    return rows

@@ -20,6 +20,7 @@ set/get_weight.
 
 from __future__ import annotations
 
+import os
 import re
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -37,6 +38,7 @@ from .parallel import MeshContext, make_mesh_context, shard_map
 from .parallel.compat import GRADS_NEED_EXPLICIT_PSUM
 from .io.data import DataBatch
 from .resilience import failpoints
+from .telemetry import modelhealth
 from .telemetry.trace import TRACER
 from . import checkpoint as ckpt
 
@@ -175,6 +177,17 @@ class Trainer:
         self.shard_ckpt = _ckpt_cfg.shard_ckpt
         self.shard_ckpt_shards = _ckpt_cfg.shard_ckpt_shards
         self._warned_no_ckpt_barrier = False
+        # model-health probe (doc/tasks.md "Model health"): health = 1
+        # makes the std/sp step bodies compute compact per-layer
+        # numerics IN-TRACE and return them as one extra fp32 pytree;
+        # health = 0 leaves every step builder on the exact pre-health
+        # path (jaxpr-identity pinned by tests/test_modelhealth.py)
+        from .config import parse_health_config
+        self.health_cfg = parse_health_config(cfg)
+        self.health_on = bool(self.health_cfg.enabled)
+        self._last_health = None
+        self._health_batch = None
+        self._warned_health_chain = False
         dev = gp("dev", "")
         model_parallel = int(gp("model_parallel", "1"))
         seq_parallel = int(gp("seq_parallel", "1"))
@@ -254,6 +267,15 @@ class Trainer:
                              "replicated) — layer kernels stay fused")
             else:
                 self.optimizer.fused_spmd = self.net.fused_spmd
+        if self.health_on and self._pp > 1:
+            # the pp step's stat plumbing is the microbatch ring's stat
+            # sink — per-step health trees do not ride it; std (GSPMD
+            # dp/tp) and sp steps carry the probe, pp falls back loudly
+            print("WARNING: health=1 has no in-step probe on "
+                  "pipeline-parallel meshes; model-health telemetry "
+                  "disabled for this run (std/sp steps only)",
+                  flush=True)
+            self.health_on = False
         # metric bindings (reference nnet_impl-inl.hpp:73-83)
         self.metric = MetricSet()
         self.train_metric = MetricSet()
@@ -715,6 +737,11 @@ class Trainer:
         self.sample_counter = 0
         self._last_loss = None
         self._pending_metric = None
+        # step-local health state refers to the poisoned step — the
+        # provenance walk (modelhealth.diagnose_nonfinite) runs BEFORE
+        # the rollback; afterwards it must not linger
+        self._last_health = None
+        self._health_batch = None
         return self.round_counter
 
     def copy_model_from(self, path: str) -> None:
@@ -837,6 +864,9 @@ class Trainer:
         bank = bool(multi and self.eval_train)
         needed = self._needed_nodes() if (bank or not chain) else []
         capture = bool(needed)
+        # model health rides the PLAIN sp step only; sp chains keep the
+        # pre-health body (update_chain_batches warns once)
+        health_on = self.health_on and not chain
 
         ranges = list(self.graph.label_range)
 
@@ -853,12 +883,18 @@ class Trainer:
                 res = net.apply(p, net_state, data, None, mask, rng=rng_l,
                                 train=True, seq_axis=seq_axis,
                                 data_axis=data_axis, capture_nodes=capture,
-                                label_slices=lslices)
+                                label_slices=lslices, health=health_on)
                 loss = jax.lax.pmean(
                     jax.lax.pmean(res.loss, seq_axis), data_axis)
-                return loss, (res.state, _collect_nodes(res, needed))
-            (loss, (new_state, nodes)), grads = _scaled_value_and_grad(
+                aux = (res.state, _collect_nodes(res, needed))
+                return loss, aux + ((res.health,) if health_on else ())
+            (loss, aux), grads = _scaled_value_and_grad(
                 loss_fn, params, opt_state)
+            if health_on:
+                new_state, nodes, act = aux
+            else:
+                new_state, nodes = aux
+                act = None
             if GRADS_NEED_EXPLICIT_PSUM:
                 # pre-check_vma JAX: each shard's grad here is the FULL
                 # gradient of its LOCAL loss term (the pmean transposes
@@ -873,9 +909,20 @@ class Trainer:
             new_state = jax.tree_util.tree_map(
                 lambda x: jax.lax.pmean(
                     jax.lax.pmean(x, seq_axis), data_axis), new_state)
+            p_old, o_old = params, opt_state
             params, opt_state, accum = _apply_grads(
                 opt, period, do_update, params, opt_state, accum, grads,
                 sched)
+            if health_on:
+                # grads/params are replicated by here (post-psum), so
+                # their stats agree on every shard; the shard-LOCAL
+                # activation stats reduce explicitly to the fleet view
+                health = modelhealth.step_health(
+                    grads, p_old, params, opt, o_old, opt_state,
+                    modelhealth.reduce_island(act,
+                                              (data_axis, seq_axis)))
+                return (params, opt_state, new_state, accum, loss,
+                        nodes, health, jax.random.fold_in(rng, 1))
             # the rng key chains device-side (no per-step host upload)
             return (params, opt_state, new_state, accum, loss, nodes,
                     jax.random.fold_in(rng, 1))
@@ -933,11 +980,15 @@ class Trainer:
                 out_specs=(rep, rep, rep, rep, rep),
                 axis_names={data_axis, seq_axis})
         else:
+            # the health pytree (when carried) is replicated by
+            # construction (see `one`): a single P() prefix covers it
+            out_specs = (rep, rep, rep, rep, rep, nodes_spec) \
+                + ((rep,) if health_on else ()) + (rep,)
             wrapped = shard_map(
                 step, mesh=self.mesh.mesh,
                 in_specs=(rep, rep, rep, rep, data_spec, lspec,
                           P(data_axis), rep, rep),
-                out_specs=(rep, rep, rep, rep, rep, nodes_spec, rep),
+                out_specs=out_specs,
                 axis_names={data_axis, seq_axis})
         # chain: arg 3 is the batch — donate only the carried state
         return jax.jit(wrapped,
@@ -1547,6 +1598,10 @@ class Trainer:
         bank = bool(multi and self.eval_train)
         needed = self._needed_nodes() if (bank or not chain) else []
         capture = bool(needed)
+        # model health rides plain steps and multi chains; fixed-batch
+        # (bench) chains never carry it. health_on False leaves every
+        # closure below on the exact pre-health path.
+        health_on = self.health_on and (not chain or multi)
 
         def fwd_bwd(params, opt_state, net_state, data, label, mask,
                     extra, rng):
@@ -1556,8 +1611,10 @@ class Trainer:
             def loss_fn(p):
                 res = net.apply(p, net_state, data, label, mask,
                                 extra_data=extra, rng=rng, train=True,
-                                capture_nodes=capture)
-                return res.loss, (res.state, _collect_nodes(res, needed))
+                                capture_nodes=capture, health=health_on)
+                aux = (res.state, _collect_nodes(res, needed))
+                return res.loss, aux + ((res.health,) if health_on
+                                        else ())
             return _scaled_value_and_grad(loss_fn, params, opt_state)
 
         def one(params, opt_state, net_state, accum, data, label, mask,
@@ -1566,6 +1623,18 @@ class Trainer:
             # here, in-trace (fixed-batch chains re-fold per scan step —
             # that IS the fused read: u8 in, compute dtype out)
             data = _fold_input(data, net)
+            if health_on:
+                (loss, (new_state, nodes, act)), grads = fwd_bwd(
+                    params, opt_state, net_state, data, label, mask,
+                    extra, rng)
+                p_old, o_old = params, opt_state
+                params, opt_state, accum = _apply_grads(
+                    opt, period, do_update, params, opt_state, accum,
+                    grads, sched)
+                health = modelhealth.step_health(
+                    grads, p_old, params, opt, o_old, opt_state, act)
+                return (params, opt_state, new_state, accum, loss,
+                        nodes, health, jax.random.fold_in(rng, 1))
             (loss, (new_state, nodes)), grads = fwd_bwd(
                 params, opt_state, net_state, data, label, mask, extra, rng)
             params, opt_state, accum = _apply_grads(
@@ -1582,17 +1651,26 @@ class Trainer:
             # scan carry, and the optimizer applies under lax.cond on
             # the period boundary — chains need not align with periods
             def one_acc(p, o, s, a, c, d, l, m, e, r, sc):
-                (loss, (new_state, nodes)), grads = fwd_bwd(
-                    p, o, s, d, l, m, e, r)
+                if health_on:
+                    (loss, (new_state, nodes, act)), grads = fwd_bwd(
+                        p, o, s, d, l, m, e, r)
+                else:
+                    (loss, (new_state, nodes)), grads = fwd_bwd(
+                        p, o, s, d, l, m, e, r)
+                    act = None
                 a = jax.tree_util.tree_map(jnp.add, a, grads)
 
+                p_old, o_old = p, o
                 p, o, a = jax.lax.cond(
                     (c + 1) % period == 0,
                     lambda args: _apply_accum(opt, period, args[0],
                                               args[1], args[2], args[3]),
                     lambda args: (args[0], args[1], args[2]),
                     (p, o, a, sc))
-                return (p, o, new_state, a, c + 1, loss, nodes,
+                health = (modelhealth.step_health(
+                    grads, p_old, p, opt, o_old, o, act)
+                    if health_on else None)
+                return (p, o, new_state, a, c + 1, loss, nodes, health,
                         jax.random.fold_in(r, 1))
 
             def step(params, opt_state, net_state, accum, cnt0, data,
@@ -1606,15 +1684,25 @@ class Trainer:
                 def sbody(carry, xs):
                     p, o, s, a, c, r = carry
                     d, l, m, e, sc = xs
-                    p, o, s, a, c, loss, nodes, r = one_acc(
+                    p, o, s, a, c, loss, nodes, health, r = one_acc(
                         p, o, s, a, c, d, l, m, e, r, sc)
-                    return (p, o, s, a, c, r), (loss,
-                                                nodes if bank else {})
-                (params, opt_state, net_state, accum, _c, rng), \
-                    (losses, nodes) = jax.lax.scan(
+                    ys = (loss, nodes if bank else {}) \
+                        + ((health,) if health_on else ())
+                    return (p, o, s, a, c, r), ys
+                (params, opt_state, net_state, accum, _c, rng), ys = \
+                    jax.lax.scan(
                         sbody,
                         (params, opt_state, net_state, accum, cnt0, rng),
                         (data, label, mask, extra, sched))
+                if health_on:
+                    losses, nodes, healths = ys
+                    # the chain's LAST step is the probe's view (stats
+                    # are per-step; the newest is what the sync reads)
+                    health = jax.tree_util.tree_map(lambda v: v[-1],
+                                                    healths)
+                    return (params, opt_state, net_state, losses, nodes,
+                            health, accum, rng)
+                losses, nodes = ys
                 return (params, opt_state, net_state, losses, nodes,
                         accum, rng)
             return jax.jit(step, donate_argnums=(0, 1, 2, 3))
@@ -1629,13 +1717,26 @@ class Trainer:
                 def sbody(carry, xs):
                     p, o, s, r = carry
                     d, l, m, e, sc = xs
+                    if health_on:
+                        p, o, s, _a, loss, nodes, health, r = one(
+                            p, o, s, {}, d, l, m, e, r, sc)
+                        return (p, o, s, r), (loss,
+                                              nodes if bank else {},
+                                              health)
                     p, o, s, _a, loss, nodes, r = one(
                         p, o, s, {}, d, l, m, e, r, sc)
                     return (p, o, s, r), (loss, nodes if bank else {})
-                (params, opt_state, net_state, rng), (losses, nodes) = \
+                (params, opt_state, net_state, rng), ys = \
                     jax.lax.scan(sbody,
                                  (params, opt_state, net_state, rng),
                                  (data, label, mask, extra, sched))
+                if health_on:
+                    losses, nodes, healths = ys
+                    health = jax.tree_util.tree_map(lambda v: v[-1],
+                                                    healths)
+                    return (params, opt_state, net_state, losses, nodes,
+                            health, rng)
+                losses, nodes = ys
                 return params, opt_state, net_state, losses, nodes, rng
             return jax.jit(step, donate_argnums=(0, 1, 2))
         if chain:
@@ -1794,6 +1895,15 @@ class Trainer:
             self._rng_key = jax.random.fold_in(self._base_key,
                                                self._step_count)
         sched = self._sched_stack(k)
+        # the sp chain bodies keep the pre-health path (see
+        # _make_sp_train_step); std multi chains carry the health tree
+        health_here = self.health_on and self._sp == 1
+        if self.health_on and not health_here \
+                and not self._warned_health_chain:
+            self._warned_health_chain = True
+            print("WARNING: health=1 does not ride sp train chains; "
+                  "model-health stats are unavailable for this "
+                  "dispatch family", flush=True)
         if period > 1:
             # accumulator + sample counter thread through the chain so
             # period boundaries need not align with chain boundaries
@@ -1804,16 +1914,27 @@ class Trainer:
                     or self._cnt_cache[0] != self.sample_counter:
                 self._cnt_cache = (self.sample_counter,
                                    jnp.int32(self.sample_counter))
-            (self.params, self.opt_state, self.net_state, losses, nodes,
-             self.accum, self._rng_key) = self._train_step_fns[key](
+            out = self._train_step_fns[key](
                  self.params, self.opt_state, self.net_state, self.accum,
                  self._cnt_cache[1], data, label, masks,
                  *args_extra, self._rng_key, sched)
+            if health_here:
+                (self.params, self.opt_state, self.net_state, losses,
+                 nodes, self._last_health, self.accum,
+                 self._rng_key) = out
+            else:
+                (self.params, self.opt_state, self.net_state, losses,
+                 nodes, self.accum, self._rng_key) = out
         else:
-            (self.params, self.opt_state, self.net_state, losses, nodes,
-             self._rng_key) = self._train_step_fns[key](
+            out = self._train_step_fns[key](
                  self.params, self.opt_state, self.net_state, data,
                  label, masks, *args_extra, self._rng_key, sched)
+            if health_here:
+                (self.params, self.opt_state, self.net_state, losses,
+                 nodes, self._last_health, self._rng_key) = out
+            else:
+                (self.params, self.opt_state, self.net_state, losses,
+                 nodes, self._rng_key) = out
         self._last_loss = losses[-1]
         self._step_count += k
         total = self.sample_counter + k
@@ -1989,6 +2110,7 @@ class Trainer:
         # normalize happens inside the step (no-op for sp/pp staging,
         # which normalized eagerly)
         data, label = self._fold_args(staged), staged.label
+        rng_in = self._rng_key
         if self._pp > 1:
             (self.params, self.opt_state, self.net_state, accum, loss,
              nodes, self._rng_key) = step(
@@ -1996,11 +2118,29 @@ class Trainer:
                  accum_in, data, label, mask, self._rng_key,
                  self._sched_scalars())
         elif self._sp > 1:
+            if self.health_on:
+                (self.params, self.opt_state, self.net_state, accum,
+                 loss, nodes, self._last_health, self._rng_key) = step(
+                     self.params, self.opt_state, self.net_state,
+                     accum_in, data, label, mask, self._rng_key,
+                     self._sched_scalars())
+            else:
+                (self.params, self.opt_state, self.net_state, accum,
+                 loss, nodes, self._rng_key) = step(
+                     self.params, self.opt_state, self.net_state,
+                     accum_in, data, label, mask, self._rng_key,
+                     self._sched_scalars())
+        elif self.health_on:
             (self.params, self.opt_state, self.net_state, accum, loss,
-             nodes, self._rng_key) = step(
+             nodes, self._last_health, self._rng_key) = step(
                  self.params, self.opt_state, self.net_state,
-                 accum_in, data, label, mask, self._rng_key,
-                 self._sched_scalars())
+                 accum_in, data, label, mask, tuple(staged.extra_data),
+                 self._rng_key, self._sched_scalars())
+            # stash the step's inputs (device references, one batch) so
+            # the one-shot NaN-provenance walk can re-run this exact
+            # forward/backward (modelhealth.diagnose_nonfinite)
+            self._health_batch = (data, label, mask,
+                                  tuple(staged.extra_data), rng_in)
         else:
             (self.params, self.opt_state, self.net_state, accum, loss,
              nodes, self._rng_key) = step(
@@ -2014,10 +2154,25 @@ class Trainer:
             # injected bad step: poison params AND the loss exactly the
             # way a real divergent/NaN step would — the sentinel must
             # catch the loss and the rollback must restore the params
-            # (a loss-only poison would let a broken rollback path pass)
+            # (a loss-only poison would let a broken rollback path pass).
+            # CXXNET_NAN_LAYER=<name> confines the poison to ONE layer's
+            # params — the provenance smoke's ground truth: the
+            # diagnostic walk must name exactly that layer
+            # (tools/smoke_health.py).
             nan = jnp.float32(float("nan"))
-            self.params = jax.tree_util.tree_map(
-                lambda x: x + nan.astype(x.dtype), self.params)
+            target = os.environ.get("CXXNET_NAN_LAYER", "")
+            if target and target not in self.params:
+                raise ValueError(
+                    "CXXNET_NAN_LAYER=%r names no param layer (have: %s)"
+                    % (target, ", ".join(sorted(self.params))))
+            if target:
+                p = dict(self.params)
+                p[target] = jax.tree_util.tree_map(
+                    lambda x: x + nan.astype(x.dtype), p[target])
+                self.params = p
+            else:
+                self.params = jax.tree_util.tree_map(
+                    lambda x: x + nan.astype(x.dtype), self.params)
             self._last_loss = float("nan")
         self._step_count += 1
         self.sample_counter += 1
@@ -2324,6 +2479,15 @@ class Trainer:
         a ready-future for telemetry probes that must choose when to
         sync, unlike :attr:`last_loss` which blocks immediately."""
         return self._last_loss
+
+    @property
+    def last_health_handle(self):
+        """The last dispatched step's model-health pytree as DEVICE
+        values (or None when health is off / the dispatch family does
+        not carry it) — same deferred-sync contract as
+        :attr:`last_loss_handle`: the HealthProbe decides when to pay
+        the host sync (telemetry/modelhealth.py)."""
+        return self._last_health
 
     def params_finite(self) -> bool:
         """Device-side finiteness probe over the param masters (one tiny

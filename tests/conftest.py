@@ -93,6 +93,7 @@ QUICK_MODULES = {
     "test_lint.py",
     "test_matlab_wrapper.py",
     "test_mixed_precision.py",
+    "test_modelhealth.py",
     "test_optim.py",
     "test_resilience.py",
     "test_serve.py",

@@ -161,7 +161,9 @@ def section_incidents(events: List[Dict], out: List[str]) -> None:
                   "serve_start", "weights_reload", "replica_state",
                   # elastic lifecycle renders in the topology timeline
                   "elastic_join", "elastic_leave", "topology_change",
-                  "elastic_resume", "elastic_advice")]
+                  "elastic_resume", "elastic_advice",
+                  # model-health trail renders in its own section
+                  "model_health", "health_advice")]
     if not incidents:
         out.append("No incidents recorded — clean run.")
         out.append("")
@@ -178,6 +180,8 @@ def section_incidents(events: List[Dict], out: List[str]) -> None:
             line += ": round %s -> %s (lr_scale %s)" % (
                 e.get("round", "?"), e.get("to_round", "?"),
                 _fmt(e.get("lr_scale", "?")))
+            if e.get("provenance"):
+                line += " — `%s`" % e["provenance"]
         elif etype == "breaker_transition":
             line += ": %s -> %s" % (e.get("from_state", "?"),
                                     e.get("to_state", "?"))
@@ -215,6 +219,72 @@ def section_incidents(events: List[Dict], out: List[str]) -> None:
                            % (len(first) - 12))
             out.append("  ```")
     out.append("")
+
+
+def section_modelhealth(events: List[Dict], out: List[str]) -> None:
+    """Model health: the per-round ``model_health`` stat trail, every
+    windowed-detector ``health_advice``, and each rollback's NaN
+    provenance — the "which layer and why" view next to the incident
+    timeline (doc/tasks.md "Model health")."""
+    mh = [e for e in events if e.get("event") == "model_health"]
+    advice = [e for e in events if e.get("event") == "health_advice"]
+    prov = [e for e in events
+            if e.get("event") in ("sentinel_trip", "rollback")
+            and e.get("provenance")]
+    if not mh and not advice and not prov:
+        return
+    out.append("## Model health")
+    out.append("")
+    if prov:
+        out.append("NaN provenance (first non-finite site per "
+                   "anomaly):")
+        out.append("")
+        for e in prov:
+            out.append("- %s `h%s` **%s** round %s: `%s`" % (
+                _ts(e.get("ts")), e.get("host", 0), e.get("event"),
+                e.get("round", "?"), e.get("provenance")))
+        out.append("")
+    if advice:
+        out.append("Training-dynamics advice (windowed detectors, "
+                   "deduped per onset):")
+        out.append("")
+        for e in advice[:100]:
+            line = "- %s `h%s` **%s** on `%s` (value %s" % (
+                _ts(e.get("ts")), e.get("host", 0), e.get("kind", "?"),
+                e.get("layer", "?"), _fmt(e.get("value", "?")))
+            if e.get("round") is not None:
+                line += ", round %s" % e.get("round")
+            if e.get("provenance"):
+                line += ", `%s`" % e["provenance"]
+            out.append(line + ")")
+        out.append("")
+    if mh:
+        out.append("| round | grad norm | dead max | BN var min | "
+                   "update ratio max | act abs-max | loss scale |")
+        out.append("|---|---|---|---|---|---|---|")
+        shown = mh if len(mh) <= 30 else mh[:10] + [None] + mh[-19:]
+        for e in shown:
+            if e is None:
+                out.append("| ... | | | | | | |")
+                continue
+
+            def pair(field):
+                v = e.get(field)
+                if v is None:
+                    return ""
+                lay = e.get(field + "_layer")
+                return "%s (%s)" % (_fmt(v), lay) if lay else _fmt(v)
+            out.append("| %s | %s | %s | %s | %s | %s | %s |" % (
+                e.get("round", "?"), _fmt(e.get("grad_norm", "")),
+                pair("dead_max"), pair("bn_var_min"),
+                pair("update_ratio_max"), pair("act_absmax"),
+                _fmt(e.get("loss_scale", ""))))
+        out.append("")
+        last = mh[-1]
+        if last.get("overflows"):
+            out.append("%s fp16 scaler-overflow step(s) observed at "
+                       "health syncs." % last["overflows"])
+            out.append("")
 
 
 _SERVE_EVENTS = ("serve_start", "weights_reload", "replica_state")
@@ -533,6 +603,7 @@ def generate(ledger_path: str, telemetry_log: Optional[str],
     section_identity(events, out)
     section_rounds(events, out)
     section_incidents(events, out)
+    section_modelhealth(events, out)
     section_serving(events, out)
     section_topology(events, out)
     section_checkpoints(events, out)
