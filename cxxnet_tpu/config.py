@@ -10,6 +10,13 @@ sections run ``data = train`` .. ``iter = end``).
 
 Unlike the reference (which silently stops parsing on a malformed token
 stream), malformed input raises :class:`ConfigError`.
+
+Validated config namespaces mostly live here (``serve_*``,
+``telemetry_*``, ``io_retry_*``, ...); subsystem-owned namespaces
+follow the same ``parse_*`` + ``known``-table contract next to the code
+they parameterize — ``deploy_*`` in :mod:`cxxnet_tpu.deploy.policy`,
+``elastic_*`` in the elastic package. graftlint's config-namespace pass
+harvests every such table, wherever it lives.
 """
 
 from __future__ import annotations

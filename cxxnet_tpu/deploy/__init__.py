@@ -1,0 +1,28 @@
+"""Closed-loop continuous deployment: a health-gated canary controller
+that auto-promotes and auto-rolls-back checkpoints (ROADMAP item 6).
+
+The observability PRs built the evidence (ckpt_health verdicts,
+per-version SLO burn and outcome stats, breaker trips, distributed
+traces, NaN provenance); this subsystem is the control plane that
+SPENDS it:
+
+* :mod:`.policy`     — the validated ``deploy_*`` config namespace
+  (window length, burn/parity thresholds, canary count,
+  hold-after-rollback backoff);
+* :mod:`.gates`      — promotion evidence: the offline library
+  ckpt_health gate plus the online canary-window battery (SLO burn,
+  breaker trips, deterministic shadow-probe output parity vs the
+  incumbent);
+* :mod:`.controller` — the state machine riding the A/B reload
+  machinery: new valid round -> offline gate -> canary -> window hold
+  -> promote on clean evidence, or roll back and emit a
+  ``deploy_incident`` naming the failing gate, the failing request
+  trace ids, and the poisoned layer.
+"""
+
+from .policy import DeployConfig, parse_deploy_config
+from .gates import GateResult
+from .controller import DeployController
+
+__all__ = ["DeployConfig", "parse_deploy_config", "GateResult",
+           "DeployController"]

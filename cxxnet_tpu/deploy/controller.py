@@ -1,0 +1,339 @@
+"""The closed-loop deployment controller: canary, gate, promote or
+roll back — no human in the promotion path.
+
+Rides the :class:`~cxxnet_tpu.serve.reload.ReloadWatcher` round-scan
+pattern (cheap ``find_latest`` gate, verified ``find_latest_valid``
+read, rolling drain+swap through the A/B machinery) and closes the
+loop ROADMAP item 6 left open: the trainer publishes rounds, the fleet
+can canary them, ckpt_health can judge them — this state machine is
+the thing that actually decides.
+
+Per new valid round:
+
+1. **offline gate** (gates.offline_gate, the library ckpt_health
+   verdict): RELOAD-UNSAFE blocks before any replica is touched — the
+   ``deploy_incident`` names the poisoned layer exactly like the
+   trainer-side NaN-provenance walk names it; RELOAD-SUSPECT extends
+   the canary window by ``deploy_suspect_factor``;
+2. **canary** — the pre-canary weights of the canary subset are
+   snapshotted (host copies: the rollback target must not depend on
+   the incumbent checkpoint still being on disk), then
+   ``deploy_canary_replicas`` are reloaded via the watcher's A/B path;
+3. **window hold** — live traffic and the injected-clock window
+   accumulate evidence;
+4. **verdict** — the online gate battery (burn, breaker, parity) runs
+   at window close. All clean: :meth:`promote` rolls the REST of the
+   fleet onto the exact gated blob (never a newer un-gated round — a
+   trainer that kept publishing cannot race an ungated checkpoint
+   through promotion). Any veto: the canaries are rolled back to their
+   snapshotted incumbent weights, a ``deploy_rollback`` +
+   ``deploy_incident`` land in the ledger (failing gate, failing
+   request trace ids, poisoned layers), and the hold-after-rollback
+   backoff keeps a flapping trainer from re-canarying the same bad
+   round.
+
+``poll_s <= 0`` disables the background thread — tests and the smoke
+drive :meth:`check_once` manually with an injected clock, exactly like
+the watcher. Duck-types the watcher's server-facing surface
+(``start``/``stop``/``snapshot``/``interval_s``) so ``task_serve``
+hands it to :class:`~cxxnet_tpu.serve.server.ServeServer` unchanged.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from ..serve.fleet import ReplicaPool
+from ..serve.reload import ReloadWatcher
+from ..serve.engine import version_name
+from ..telemetry.ledger import LEDGER
+from .. import checkpoint as ckpt
+from . import gates
+from .gates import GateResult
+from .policy import DeployConfig
+
+
+class DeployController:
+    """Health-gated canary deployment over a live replica pool."""
+
+    def __init__(self, pool: ReplicaPool, model_dir: str,
+                 cfg: DeployConfig, drain_timeout_s: float = 30.0,
+                 clock=time.monotonic, verbose: bool = False):
+        if len(pool.replicas) < 2:
+            raise ValueError(
+                "deploy controller needs at least 2 replicas: one "
+                "canary and one incumbent to compare it against")
+        self.pool = pool
+        self.model_dir = model_dir
+        self.cfg = cfg
+        self.verbose = verbose
+        self._clock = clock
+        # the A/B reload machinery does the actual drain+swap work;
+        # interval 0 = the controller owns the poll cadence
+        self.watcher = ReloadWatcher(
+            pool, model_dir, interval_s=0,
+            ab_replicas=min(cfg.canary_replicas,
+                            len(pool.replicas) - 1),
+            drain_timeout_s=drain_timeout_s, verbose=verbose)
+        self.interval_s = cfg.poll_s    # ServeServer's watcher surface
+        self.promotions = 0
+        self.rollbacks = 0
+        self.incidents = 0
+        self.last_error: str = ""
+        # live canary state (None = idle): round/digest/path/blob,
+        # window deadline, suspect flag, pre-canary replica snapshots,
+        # breaker-opens baseline
+        self._canary: Optional[Dict[str, Any]] = None
+        # hold-after-rollback: rejected rounds/digests are never
+        # re-canaried; nothing new is canaried before _hold_until
+        self._rejected_rounds: set = set()
+        self._rejected_digests: set = set()
+        self._hold_until = 0.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()   # one check_once at a time
+
+    # -- lifecycle (watcher-compatible) ----------------------------------
+    def start(self) -> "DeployController":
+        if self.interval_s > 0 and self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="deploy-control")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.watcher._stop.set()      # abort an in-progress sweep too
+        if self._thread is not None:
+            # worst case: one poll plus one in-progress drain
+            self._thread.join(timeout=self.interval_s
+                              + self.watcher.drain_timeout_s + 30)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.check_once()
+            except Exception as e:   # noqa: BLE001 — controller must survive
+                # a bad poll (transient IO, mid-write races) must not
+                # kill the control loop; the next tick retries
+                self.last_error = f"{type(e).__name__}: {e}"
+                if self.verbose:
+                    print(f"deploy: poll failed: {self.last_error}",
+                          flush=True)
+
+    # -- the control loop ------------------------------------------------
+    def check_once(self) -> str:
+        """One control-loop tick. Returns the action taken:
+        ``""`` (nothing to do / window still open), ``"canary"``,
+        ``"blocked"`` (offline gate rejected before any replica was
+        touched), ``"promote"`` or ``"rollback"``."""
+        with self._lock:
+            if self._canary is not None:
+                return self._evaluate()
+            return self._scan()
+
+    def _scan(self) -> str:
+        now = self._clock()
+        if now < self._hold_until:
+            return ""
+        latest = ckpt.find_latest(self.model_dir)
+        if latest is None or latest[0] <= self.pool.newest_round() \
+                or latest[0] in self._rejected_rounds:
+            return ""
+        valid = ckpt.find_latest_valid(self.model_dir, want_blob=True,
+                                       verbose=self.verbose)
+        if valid is None:
+            return ""
+        r, path, blob = valid
+        if r <= self.pool.newest_round() or r in self._rejected_rounds:
+            return ""
+        digest = ckpt.blob_digest(blob["meta"])
+        if digest in self._rejected_digests:
+            return ""
+        # offline gate BEFORE any replica is touched
+        inc_round = self.pool.newest_round()
+        inc_blob = inc_digest = None
+        if inc_round >= 0:
+            try:
+                inc_path = ckpt.model_path(self.model_dir, inc_round)
+                inc_blob = ckpt.load_for_inference(inc_path)
+                inc_digest = ckpt.blob_digest(inc_blob["meta"])
+            except Exception:   # noqa: BLE001 — incumbent may be pruned
+                # the incumbent checkpoint is gone/corrupt: the gate
+                # degrades to the single-blob (finiteness) check
+                inc_blob = None
+        g = gates.offline_gate(blob, inc_blob, self.cfg,
+                               digest_c=digest,
+                               digest_i=inc_digest or "")
+        if not g.passed:
+            self._reject(r, digest, g, rolled_back=False)
+            return "blocked"
+        suspect = bool(g.details.get("suspect"))
+        window = self.cfg.window_s * (self.cfg.suspect_factor
+                                      if suspect else 1.0)
+        snapshots = self._snapshot_canaries()
+        moved = self.watcher.reload_from_blob(blob, path=path,
+                                              canary=True)
+        if moved == 0:
+            return ""
+        idxs = list(range(self.watcher.ab_replicas))
+        self._canary = {
+            "round": r, "digest": digest, "path": path, "blob": blob,
+            "version": version_name(r),
+            "incumbent_round": inc_round,
+            "suspect": suspect,
+            "deadline": self._clock() + window,
+            "window_s": window,
+            "idxs": idxs,
+            "snapshots": snapshots,
+            "baseline_opens": {i: self.pool.replicas[i].breaker.opens
+                               for i in idxs},
+        }
+        if self.verbose:
+            print(f"deploy: canary {version_name(r)} on replicas "
+                  f"{idxs}, window {window:.3g}s"
+                  + (" (SUSPECT-extended)" if suspect else ""),
+                  flush=True)
+        return "canary"
+
+    def _evaluate(self) -> str:
+        c = self._canary
+        if self._clock() < c["deadline"]:
+            return ""
+        incumbent = self._incumbent_version(c)
+        results = gates.online_gates(
+            self.pool, c["idxs"], c["version"], incumbent, self.cfg,
+            c["baseline_opens"])
+        failing = next((g for g in results if not g.passed), None)
+        if failing is None:
+            return self._promote(c, results)
+        return self._rollback(c, failing)
+
+    def _incumbent_version(self, c: Dict[str, Any]) -> str:
+        """The version the non-canary replicas serve (parity's other
+        arm) — read from the pool, not assumed from the round scan."""
+        for rep in self.pool.replicas:
+            if rep.idx not in c["idxs"]:
+                return rep.version
+        return version_name(c["incumbent_round"])
+
+    # -- verdicts --------------------------------------------------------
+    def _promote(self, c: Dict[str, Any],
+                 results: List[GateResult]) -> str:
+        # promote the exact gated blob: every replica not already on
+        # the canary version rolls onto it — NOT watcher.promote(),
+        # which would chase the newest round on disk and could ship a
+        # round that never saw a gate
+        behind = [rep.idx for rep in self.pool.replicas
+                  if rep.version != c["version"]]
+        if behind:
+            self.watcher.reload_from_blob(c["blob"], path=c["path"],
+                                          targets=behind, canary=False)
+        vs = self.pool.version_stats().get(c["version"], {})
+        LEDGER.event("deploy_promote", round=c["round"],
+                     digest=c["digest"], version=c["version"],
+                     window_s=round(c["window_s"], 3),
+                     suspect=c["suspect"],
+                     canary_replicas=len(c["idxs"]),
+                     canary_requests=vs.get("requests", 0),
+                     canary_failed=vs.get("failed", 0),
+                     gates=[g.gate for g in results])
+        self.promotions += 1
+        self._canary = None
+        if self.verbose:
+            print(f"deploy: promoted {c['version']} "
+                  f"({c['digest']})", flush=True)
+        return "promote"
+
+    def _rollback(self, c: Dict[str, Any], failing: GateResult) -> str:
+        # restore every canary replica from its pre-canary snapshot
+        # (drain+swap through the same zero-drop path the canary used)
+        for snap in c["snapshots"]:
+            idx = snap["idx"]
+            old_round = self.pool.reload_replica(
+                idx, snap["params"], snap["state"], snap["round"],
+                digest=snap["digest"],
+                drain_timeout_s=self.watcher.drain_timeout_s)
+            eng = self.pool.replicas[idx].engine
+            if snap["version"] == "init":
+                # snapshot round 0 of never-checkpointed weights must
+                # answer to "init" again, not to a round-shaped pin
+                eng.weights_version = "init"
+                eng.weights_digest = ""
+            LEDGER.event("weights_reload", replica=idx,
+                         old_round=old_round, new_round=snap["round"],
+                         digest=snap["digest"], path="",
+                         canary=True, rollback=True)
+        LEDGER.event("deploy_rollback", round=c["round"],
+                     digest=c["digest"], version=c["version"],
+                     incumbent_round=c["incumbent_round"],
+                     replicas=list(c["idxs"]), gate=failing.gate)
+        self.rollbacks += 1
+        self._reject(c["round"], c["digest"], failing,
+                     rolled_back=True)
+        self._canary = None
+        if self.verbose:
+            print(f"deploy: rolled back {c['version']} — "
+                  f"{failing.gate} gate: {failing.reason}", flush=True)
+        return "rollback"
+
+    def _reject(self, r: int, digest: str, g: GateResult,
+                rolled_back: bool) -> None:
+        now = self._clock()
+        self._rejected_rounds.add(r)
+        self._rejected_digests.add(digest)
+        self._hold_until = now + self.cfg.backoff_s
+        LEDGER.event("deploy_incident", round=r, digest=digest,
+                     gate=g.gate, reason=g.reason,
+                     layers=g.layers, provenance=g.provenance,
+                     trace_ids=g.trace_ids,
+                     rolled_back=rolled_back,
+                     backoff_s=self.cfg.backoff_s)
+        self.incidents += 1
+
+    # -- helpers ---------------------------------------------------------
+    def _snapshot_canaries(self) -> List[Dict[str, Any]]:
+        """Host copies of the canary subset's (params, state) plus
+        their version identity — the rollback target. Taken from the
+        live engines, not from disk: rolling back must work even when
+        the incumbent checkpoint was pruned (or never existed)."""
+        out = []
+        for i in range(self.watcher.ab_replicas):
+            eng = self.pool.replicas[i].engine
+            tr = eng.trainer
+            out.append({
+                "idx": i,
+                "params": jax.device_get(tr.mesh.gather(tr.params)),
+                "state": jax.device_get(tr.mesh.gather(tr.net_state)),
+                "round": eng.weights_round,
+                "digest": eng.weights_digest,
+                "version": eng.weights_version,
+            })
+        return out
+
+    def snapshot(self) -> Dict[str, Any]:
+        """/statz payload (the server renders it under ``reload``)."""
+        c = self._canary
+        return {
+            "model_dir": self.model_dir,
+            "interval_s": self.interval_s,
+            "state": "canary" if c is not None else "idle",
+            "canary": None if c is None else {
+                "round": c["round"], "version": c["version"],
+                "digest": c["digest"], "suspect": c["suspect"],
+                "window_s": c["window_s"], "replicas": c["idxs"]},
+            "promotions": self.promotions,
+            "rollbacks": self.rollbacks,
+            "incidents": self.incidents,
+            "rejected_rounds": sorted(self._rejected_rounds),
+            "last_error": self.last_error,
+            "watcher": self.watcher.snapshot(),
+            "running": self._thread is not None
+            and self._thread.is_alive(),
+        }

@@ -1,0 +1,185 @@
+"""Promotion evidence gates for the deployment controller.
+
+A checkpoint is promoted on EVIDENCE, never on time: before any
+replica is touched the **offline gate** runs the library ckpt_health
+verdict (telemetry/modelhealth.py ``reload_verdict``) over the
+candidate against the incumbent — RELOAD-UNSAFE blocks outright and
+carries the poisoned layer names so the fleet-side rejection matches
+the trainer-side NaN-provenance walk; RELOAD-SUSPECT does not block,
+it buys a LONGER canary window. During the canary window the **online
+gates** read the per-version stats the serving fleet already keeps:
+
+* ``burn``    — worst canary-replica SLO burn rate stays below
+  ``deploy_burn_max``;
+* ``breaker`` — zero circuit-breaker trips on any canary replica
+  since the canary started;
+* ``parity``  — a deterministic shadow-probe batch (seeded, so every
+  evaluation asks the same questions) is sent to BOTH the canary and
+  the incumbent version via the A/B router pin, and the fraction of
+  disagreeing predictions must stay within ``deploy_parity_tol``.
+
+Each gate returns a :class:`GateResult`; a failing result carries the
+trace ids of the requests that produced the evidence (probe traces for
+parity, the pool's recent failed-request traces for burn/breaker) so
+the ``deploy_incident`` ledger event joins the assembled fleet trace.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..telemetry.disttrace import DISTTRACE
+from ..telemetry.modelhealth import reload_verdict
+from .policy import DeployConfig
+
+
+@dataclasses.dataclass
+class GateResult:
+    """One gate's verdict: what passed/failed, why, and the evidence
+    trail (trace ids, poisoned layers) an incident event needs."""
+    gate: str
+    passed: bool
+    reason: str
+    details: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    trace_ids: List[str] = dataclasses.field(default_factory=list)
+    layers: List[str] = dataclasses.field(default_factory=list)
+    provenance: str = ""
+
+
+# -- offline gate --------------------------------------------------------------
+
+def offline_gate(candidate_blob, incumbent_blob, cfg: DeployConfig,
+                 digest_c: str = "", digest_i: str = "") -> GateResult:
+    """Library ckpt_health verdict over candidate vs incumbent (or the
+    candidate alone when no incumbent checkpoint exists). UNSAFE fails
+    the gate; SUSPECT passes with ``details["suspect"] = True`` so the
+    controller extends the canary window."""
+    res = reload_verdict(incumbent_blob, candidate_blob,
+                         max_ratio=cfg.max_ratio,
+                         digest_a=digest_i, digest_b=digest_c) \
+        if incumbent_blob is not None else \
+        reload_verdict(candidate_blob, max_ratio=cfg.max_ratio,
+                       digest_a=digest_c)
+    return GateResult(
+        gate="offline", passed=res["exit_code"] != 2,
+        reason=res["line"],
+        details={"verdict": res["verdict"],
+                 "suspect": res["exit_code"] == 1,
+                 "worst": res["worst"]},
+        layers=res["layers"], provenance=res["provenance"])
+
+
+# -- online gates --------------------------------------------------------------
+
+def burn_gate(pool, canary_idxs: List[int], canary_version: str,
+              cfg: DeployConfig) -> GateResult:
+    """Worst canary-replica SLO burn rate below ``deploy_burn_max``.
+    With SLO tracking off (serve_slo_ms = 0) every burn reads 0.0 and
+    the gate trivially passes — the breaker and parity gates still
+    stand between a bad model and promotion."""
+    burns = {i: pool.replicas[i].burn_rate() for i in canary_idxs}
+    worst = max(burns.values()) if burns else 0.0
+    ok = worst < cfg.burn_max
+    return GateResult(
+        gate="burn", passed=ok,
+        reason=("canary burn %.3g within deploy_burn_max %.3g"
+                % (worst, cfg.burn_max)) if ok else
+               ("canary SLO burn %.3g >= deploy_burn_max %.3g"
+                % (worst, cfg.burn_max)),
+        details={"burns": burns, "burn_max": cfg.burn_max},
+        trace_ids=[] if ok else pool.failed_traces(canary_version))
+
+
+def breaker_gate(pool, canary_idxs: List[int], canary_version: str,
+                 baseline_opens: Dict[int, int]) -> GateResult:
+    """Zero circuit-breaker trips on any canary replica since the
+    canary window opened (``baseline_opens`` is the per-replica
+    ``breaker.opens`` snapshot taken at canary start)."""
+    trips = {i: pool.replicas[i].breaker.opens - baseline_opens.get(i, 0)
+             for i in canary_idxs}
+    total = sum(trips.values())
+    return GateResult(
+        gate="breaker", passed=total == 0,
+        reason="zero canary breaker trips" if total == 0 else
+               "%d canary breaker trip(s): %s" % (total, trips),
+        details={"trips": trips},
+        trace_ids=[] if total == 0 else
+        pool.failed_traces(canary_version))
+
+
+def probe_batch(rows: int, width: int, seed: int) -> np.ndarray:
+    """The deterministic shadow-probe set: same seed -> same rows, so
+    canary and incumbent answer the SAME questions every window."""
+    return np.random.RandomState(seed).randn(rows, width) \
+        .astype(np.float32)
+
+
+def parity_gate(pool, canary_version: str, incumbent_version: str,
+                cfg: DeployConfig, width: Optional[int] = None,
+                timeout_s: float = 60.0) -> GateResult:
+    """Output parity vs the incumbent: one probe batch submitted to
+    each version via the router's version pin, predictions compared
+    row-for-row; the disagreement fraction must stay within
+    ``deploy_parity_tol``. Probe submissions run under a
+    ``deploy.probe`` distributed span so a parity incident can name
+    the exact requests that disagreed."""
+    eng = pool.replicas[0].engine
+    if width is None:
+        c, y, x = eng.input_shape
+        width = c * y * x
+    probes = probe_batch(cfg.probe_rows, width, cfg.probe_seed)
+    # chunk to the batcher's per-request cap: the probe set size is a
+    # policy knob, the admission limit is the operator's
+    chunk = max(1, eng.max_batch)
+    outs: Dict[str, np.ndarray] = {}
+    tids: List[str] = []
+    for ver in (canary_version, incumbent_version):
+        futs = []
+        with DISTTRACE.span("deploy.probe", cat="deploy",
+                            args={"version": ver,
+                                  "rows": cfg.probe_rows}) as sp:
+            ctx = getattr(sp, "ctx", None)
+            if ctx is not None and ctx.sampled:
+                tids.append(ctx.trace_id)
+            for off in range(0, cfg.probe_rows, chunk):
+                futs.append(pool.submit(probes[off:off + chunk],
+                                        kind="predict", version=ver))
+        outs[ver] = np.concatenate(
+            [np.asarray(f.result(timeout=timeout_s)) for f in futs])
+    disagree = outs[canary_version] != outs[incumbent_version]
+    frac = float(np.mean(disagree))
+    ok = frac <= cfg.parity_tol
+    return GateResult(
+        gate="parity", passed=ok,
+        reason=("probe parity %.3g within deploy_parity_tol %.3g"
+                % (frac, cfg.parity_tol)) if ok else
+               ("%d/%d probe predictions disagree with incumbent "
+                "(%.3g > deploy_parity_tol %.3g)"
+                % (int(disagree.sum()), cfg.probe_rows, frac,
+                   cfg.parity_tol)),
+        details={"disagree_frac": frac, "rows": cfg.probe_rows,
+                 "canary": canary_version,
+                 "incumbent": incumbent_version},
+        trace_ids=[] if ok else tids)
+
+
+def online_gates(pool, canary_idxs: List[int], canary_version: str,
+                 incumbent_version: str, cfg: DeployConfig,
+                 baseline_opens: Dict[int, int]) -> List[GateResult]:
+    """Run the canary-window gate battery in veto order (cheap stats
+    first, probe traffic last — a burn/breaker veto skips the probes:
+    the canary is already condemned, don't route more traffic at it).
+    Returns results up to and including the first failure."""
+    out = [burn_gate(pool, canary_idxs, canary_version, cfg)]
+    if not out[-1].passed:
+        return out
+    out.append(breaker_gate(pool, canary_idxs, canary_version,
+                            baseline_opens))
+    if not out[-1].passed:
+        return out
+    out.append(parity_gate(pool, canary_version, incumbent_version,
+                           cfg))
+    return out

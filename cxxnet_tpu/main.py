@@ -1141,11 +1141,31 @@ class LearnTask:
         serve_ab) builds a replica pool with SLO-aware routing and the
         checkpoint hot-reload watcher. Blocks until SIGINT/SIGTERM,
         then drains before exiting."""
-        from .config import parse_serve_config
+        from .config import ConfigError, parse_serve_config
+        from .deploy import DeployController, parse_deploy_config
         from .serve import InferenceEngine, ReloadWatcher, ReplicaPool
         from .serve.engine import restore_inference_blob
         from .serve.server import ServeServer
         sc = parse_serve_config(self.global_cfg)
+        dc = parse_deploy_config(self.global_cfg)
+        if dc.enable:
+            # the controller owns canary reloads end to end: a plain
+            # reload watcher racing it would ship ungated rounds
+            if sc.replicas < 2:
+                raise ConfigError(
+                    "deploy_enable = 1 needs a replica fleet "
+                    f"(serve_replicas >= 2, got {sc.replicas}): one "
+                    "canary plus at least one incumbent")
+            if sc.reload_s > 0:
+                raise ConfigError(
+                    "deploy_enable = 1 replaces serve_reload_s: the "
+                    "deployment controller decides what reloads (set "
+                    "serve_reload_s = 0 and use deploy_poll_s)")
+            if dc.canary_replicas >= sc.replicas:
+                raise ConfigError(
+                    f"deploy_canary_replicas ({dc.canary_replicas}) "
+                    f"must be < serve_replicas ({sc.replicas}): the "
+                    "parity gate compares against a live incumbent")
         # inference-only restore: params + layer state WITHOUT optimizer
         # state (momentum buffers ~double device bytes; an engine never
         # steps the optimizer) — NOT the training path's _init_model.
@@ -1188,7 +1208,18 @@ class LearnTask:
                 slo_window_s=sc.slo_window_s,
                 slo_burn_degraded=sc.slo_burn_degraded,
                 silent=bool(self.silent), **common)
-            if sc.reload_s > 0:
+            if dc.enable:
+                # closed-loop deployment: the controller polls the
+                # checkpoint directory, gates every new round offline,
+                # canaries it, and promotes/rolls back on evidence
+                # (doc/tasks.md "Continuous deployment"). Duck-types
+                # the watcher's server surface, so the server manages
+                # its lifecycle identically.
+                watcher = DeployController(
+                    pool, self.model_dir, dc,
+                    drain_timeout_s=sc.drain_timeout_s,
+                    verbose=not self.silent)
+            elif sc.reload_s > 0:
                 # hot reload watches the checkpoint directory a trainer
                 # (this process or another) keeps writing into
                 watcher = ReloadWatcher(

@@ -39,6 +39,7 @@ from __future__ import annotations
 import itertools
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional, Sequence
 
 from ..resilience import CircuitBreaker
@@ -129,9 +130,15 @@ class Replica:
         if old == new:
             return
         self._g_state.set(_STATE_CODE[new])
+        # W3C trace context rides along when a span is current (a
+        # reload sweep's drain, a traced request's drain wait) so
+        # tools/trace_assemble.py joins the transition to the requests
+        # it affected; LEDGER.event stamps trace_id itself
+        tp = DISTTRACE.current_traceparent()
         LEDGER.event("replica_state", replica=self.idx,
                      engine=self.engine.stats.instance,
-                     from_state=old, to_state=new, version=self.version)
+                     from_state=old, to_state=new, version=self.version,
+                     **({"traceparent": tp} if tp else {}))
 
     # -- router signals --------------------------------------------------
     def alive(self) -> bool:
@@ -224,6 +231,11 @@ class ReplicaPool:
         # per-version terminal-outcome accounting (the A/B comparison
         # readout): version -> {requests, ok, failed, lat_sum}
         self._vstats: Dict[str, Dict[str, float]] = {}
+        # recent failed-request trace ids per version: the evidence a
+        # deploy_incident carries so a rolled-back canary's failures
+        # are findable in the assembled fleet trace (bounded; only
+        # sampled traces land here)
+        self._failed_traces: Dict[str, deque] = {}
         self._c_version = REGISTRY.counter(
             "cxxnet_serve_version_requests_total",
             "Pool requests by model version and outcome",
@@ -422,10 +434,21 @@ class ReplicaPool:
                 vs["ok" if ok else "failed"] += 1
                 if ok:
                     vs["lat_sum"] += time.perf_counter() - t0
+                elif route_ctx is not None and route_ctx.sampled:
+                    # keep the failure's trace id: a deploy incident
+                    # names the requests that condemned the canary
+                    self._failed_traces.setdefault(
+                        ver, deque(maxlen=16)).append(route_ctx.trace_id)
             self._c_version.labels(self.instance, ver,
                                    "ok" if ok else "failed").inc()
         fut.add_done_callback(_done)
         return fut
+
+    def failed_traces(self, version: str) -> List[str]:
+        """Trace ids of recent failed requests against ``version``
+        (newest last; empty when tracing is off/unsampled)."""
+        with self._lock:
+            return list(self._failed_traces.get(version, ()))
 
     # -- reload hooks (serve/reload.py drives these) ---------------------
     def reload_replica(self, idx: int, params, net_state,

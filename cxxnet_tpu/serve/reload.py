@@ -41,6 +41,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Dict, List, Optional
 
+from ..telemetry.disttrace import DISTTRACE
 from ..telemetry.ledger import LEDGER
 from .. import checkpoint as ckpt
 from .fleet import ReplicaPool, version_name
@@ -171,13 +172,25 @@ class ReloadWatcher:
             for idx in targets:
                 if self._stop.is_set():
                     break
-                old_round = self.pool.reload_replica(
-                    idx, blob["params"], blob["state"], new_round,
-                    digest=digest, drain_timeout_s=self.drain_timeout_s)
-                LEDGER.event(
-                    "weights_reload", replica=idx,
-                    old_round=old_round, new_round=new_round,
-                    digest=digest, path=path, canary=canary)
+                # each replica's drain+swap runs under its own
+                # distributed span: the replica_state transitions and
+                # the weights_reload event below inherit the trace
+                # context, so tools/trace_assemble.py can attribute a
+                # reload-caused latency spike to this exact sweep
+                with DISTTRACE.span(
+                        "serve.reload", cat="serve",
+                        args={"replica": idx, "round": new_round,
+                              "digest": digest, "canary": canary}):
+                    old_round = self.pool.reload_replica(
+                        idx, blob["params"], blob["state"], new_round,
+                        digest=digest,
+                        drain_timeout_s=self.drain_timeout_s)
+                    tp = DISTTRACE.current_traceparent()
+                    LEDGER.event(
+                        "weights_reload", replica=idx,
+                        old_round=old_round, new_round=new_round,
+                        digest=digest, path=path, canary=canary,
+                        **({"traceparent": tp} if tp else {}))
                 done += 1
             if done == len(targets):
                 self.reloads += 1

@@ -75,6 +75,9 @@ KNOWN_EVENTS = (
     # deduped training-dynamics advice (dead-ReLU growth, BN variance
     # collapse, out-of-band update ratios, fp16 scaler overflow)
     "model_health", "health_advice",
+    # closed-loop deployment (deploy/controller.py): gated canary
+    # promotions, rollbacks, and the incident record a rejection leaves
+    "deploy_promote", "deploy_rollback", "deploy_incident",
 )
 
 

@@ -544,3 +544,166 @@ def layer_report(params, state=None) -> List[Dict[str, Any]]:
     if state:
         walk(state, "state")
     return rows
+
+
+# -- offline reload verdict (tools/ckpt_health.py, deploy/gates.py) -----------
+
+def delta_map(blob_a, blob_b) -> Dict[Tuple[str, str], float]:
+    """Per-leaf ``rms(b - a)`` from the actual tensors, keyed like the
+    :func:`layer_report` rows — value-level changes that preserve a
+    leaf's RMS (sign flips, permutations) still register."""
+    import numpy as np
+    out: Dict[Tuple[str, str], float] = {}
+
+    def walk(ta, tb, kind):
+        fa = {_leaf_key(p): l for p, l in
+              jax.tree_util.tree_flatten_with_path(ta)[0]}
+        fb = {_leaf_key(p): l for p, l in
+              jax.tree_util.tree_flatten_with_path(tb)[0]}
+        for k in set(fa) & set(fb):
+            a = np.asarray(fa[k], dtype=np.float64)
+            b = np.asarray(fb[k], dtype=np.float64)
+            if a.shape != b.shape or not a.size:
+                continue
+            out[(kind, k)] = float(np.sqrt(np.mean(np.square(b - a))))
+
+    walk(blob_a["params"], blob_b["params"], "param")
+    if blob_a.get("state") and blob_b.get("state"):
+        walk(blob_a["state"], blob_b["state"], "state")
+    return out
+
+
+def diff_rows(rows_a: List[Dict[str, Any]], rows_b: List[Dict[str, Any]],
+              deltas: Optional[Dict[Tuple[str, str], float]] = None
+              ) -> Tuple[List[Dict[str, Any]], List[str]]:
+    """Per-leaf relative-change rows + structural mismatch notes.
+
+    ``rel_change`` is ``rms(b - a) / rms(a)`` when ``deltas`` (from
+    :func:`delta_map`) is given; without tensors it degrades to the
+    summary-only ``|rms(b) - rms(a)| / rms(a)``."""
+    a = {(r["kind"], r["leaf"]): r for r in rows_a}
+    b = {(r["kind"], r["leaf"]): r for r in rows_b}
+    notes = []
+    for k in sorted(set(a) - set(b)):
+        notes.append("only in A: %s %s" % k)
+    for k in sorted(set(b) - set(a)):
+        notes.append("only in B: %s %s" % k)
+    out = []
+    for k in sorted(set(a) & set(b)):
+        ra, rb = a[k], b[k]
+        if ra["shape"] != rb["shape"]:
+            notes.append("shape mismatch at %s %s: %s vs %s"
+                         % (k[0], k[1], ra["shape"], rb["shape"]))
+            continue
+        denom = ra["rms"] or 1e-12
+        change = (deltas[k] if deltas is not None and k in deltas
+                  else abs(rb["rms"] - ra["rms"]))
+        out.append({"kind": k[0], "leaf": k[1],
+                    "rms_a": ra["rms"], "rms_b": rb["rms"],
+                    "rel_change": change / denom})
+    return out, notes
+
+
+def nonfinite_rows(rows: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Rows with any non-finite element (or a non-finite summary —
+    an all-Inf leaf has finite_frac 0 AND rms inf)."""
+    import math
+    return [r for r in rows if r["finite_frac"] < 1.0
+            or not math.isfinite(r["rms"])]
+
+
+def _row_provenance(row: Dict[str, Any]) -> str:
+    """One report row -> the ``layer=<name> kind=<kind> leaf=<leaf>``
+    provenance string :func:`diagnose_nonfinite` emits for the SAME
+    poison on the trainer side — the deploy controller's rejection and
+    the trainer's sentinel trip must name the same layer."""
+    leaf = row["leaf"]
+    layer, _, rest = leaf.partition("/")
+    return "layer=%s kind=%s leaf=%s" % (layer, row["kind"],
+                                         rest or leaf)
+
+
+def reload_verdict(blob_a, blob_b=None, max_ratio: float = 0.5,
+                   digest_a: str = "", digest_b: str = ""
+                   ) -> Dict[str, Any]:
+    """Structured serve-reload sanity verdict over one or two loaded
+    checkpoint blobs — the library form of the tools/ckpt_health.py
+    call, so in-process consumers (the deploy controller's offline
+    gate) never shell out to their own repo.
+
+    Returns a dict:
+
+    * ``verdict`` — ``RELOAD-UNSAFE`` (non-finite values or structure
+      mismatch; never serve this pair), ``RELOAD-SUSPECT`` (finite and
+      compatible but some leaf moved more than ``max_ratio`` x its own
+      RMS — canary with a longer window), ``RELOAD-SANE``,
+      ``IDENTICAL`` (digests match) or ``SANE`` (single blob, all
+      finite);
+    * ``exit_code`` — the CLI contract: 2 unsafe, 1 suspect, 0 sane;
+    * ``line`` — the one-line human verdict;
+    * ``nonfinite`` — offending report rows (B's first, A's after: a
+      candidate's poison is what a promotion gate cares about), each
+      with a ``layer`` field split off the leaf path;
+    * ``layers`` — the distinct poisoned layer names, candidate first;
+    * ``provenance`` — ``layer=<name> kind=<kind> leaf=<leaf>`` for
+      the first poisoned row, formatted exactly like
+      :func:`diagnose_nonfinite` so trainer-side and fleet-side
+      records join on the string;
+    * ``worst`` — the largest-``rel_change`` diff row (or None);
+    * ``diff`` / ``structure_notes`` / ``a_leaves`` / ``b_leaves`` —
+      the underlying tables, so the CLI renders without recomputing.
+    """
+    rows_a = layer_report(blob_a["params"], blob_a.get("state"))
+    rows_b = (layer_report(blob_b["params"], blob_b.get("state"))
+              if blob_b is not None else None)
+    res: Dict[str, Any] = {
+        "max_ratio": float(max_ratio),
+        "digest_a": digest_a, "digest_b": digest_b,
+        "a_leaves": rows_a, "b_leaves": rows_b,
+        "nonfinite": [], "layers": [], "provenance": "",
+        "worst": None, "diff": [], "structure_notes": [],
+    }
+
+    def done(verdict: str, line: str, code: int) -> Dict[str, Any]:
+        res.update(verdict=verdict, line=line, exit_code=code)
+        return res
+
+    bad = (nonfinite_rows(rows_b) if rows_b else []) \
+        + nonfinite_rows(rows_a)
+    if bad:
+        seen: List[str] = []
+        for r in bad:
+            r = dict(r)
+            r["layer"] = r["leaf"].partition("/")[0]
+            res["nonfinite"].append(r)
+            if r["layer"] not in seen:
+                seen.append(r["layer"])
+        res["layers"] = seen
+        res["provenance"] = _row_provenance(bad[0])
+        return done("RELOAD-UNSAFE",
+                    "RELOAD-UNSAFE: non-finite values in %s"
+                    % ", ".join(sorted({r["leaf"] for r in bad})[:6]), 2)
+    if rows_b is None:
+        return done("SANE", "SANE: all leaves finite (digest %s)"
+                    % (digest_a or "-"), 0)
+    deltas = delta_map(blob_a, blob_b)
+    diffs, notes = diff_rows(rows_a, rows_b, deltas)
+    res["diff"], res["structure_notes"] = diffs, notes
+    if notes:
+        return done("RELOAD-UNSAFE",
+                    "RELOAD-UNSAFE: structure mismatch — "
+                    + "; ".join(notes[:6]), 2)
+    if digest_b and digest_a and digest_a == digest_b:
+        return done("IDENTICAL", "IDENTICAL (digest %s)" % digest_a, 0)
+    worst = max(diffs, key=lambda d: d["rel_change"], default=None)
+    res["worst"] = worst
+    if worst is not None and worst["rel_change"] > max_ratio:
+        return done("RELOAD-SUSPECT",
+                    "RELOAD-SUSPECT: %s %s moved %.3gx its RMS "
+                    "(> --max-ratio %g)"
+                    % (worst["kind"], worst["leaf"],
+                       worst["rel_change"], max_ratio), 1)
+    return done("RELOAD-SANE",
+                "RELOAD-SANE: max relative change %.3g (%s)"
+                % ((worst["rel_change"], worst["leaf"]) if worst
+                   else (0.0, "-")), 0)

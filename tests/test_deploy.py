@@ -1,0 +1,377 @@
+"""Closed-loop deployment tests: deploy_* config validation, the
+offline ckpt_health gate matrix (UNSAFE blocks naming the layer,
+SUSPECT extends the window, SANE canaries), each online gate's
+individual veto (burn, breaker, parity), promotion on clean evidence,
+rollback restoring the incumbent with a full deploy_incident record,
+and the hold-after-rollback backoff — all on injected clocks."""
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu import checkpoint as ckpt
+from cxxnet_tpu.config import ConfigError, parse_config_string
+from cxxnet_tpu.deploy import (DeployController, DeployConfig,
+                               parse_deploy_config)
+from cxxnet_tpu.deploy import gates
+from cxxnet_tpu.serve import ReplicaPool
+from cxxnet_tpu.telemetry.ledger import LEDGER, new_run_id, read_ledger
+from cxxnet_tpu.telemetry.slo import SLOTracker
+from cxxnet_tpu.trainer import Trainer
+
+NET_CFG = """
+netconfig=start
+layer[+1:h1] = fullc:fc1
+  nhidden = 24
+  random_type = xavier
+layer[+1:a1] = relu
+layer[a1->out] = fullc:fc2
+  nhidden = 5
+  random_type = xavier
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,16
+batch_size = 32
+eta = 0.3
+metric = error
+"""
+
+
+def make_pool(n=2, **kw):
+    import jax
+    kw.setdefault("buckets", "2,4,8")
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("max_latency_ms", 5)
+    return ReplicaPool.build(NET_CFG, n, devices=jax.devices()[:n], **kw)
+
+
+def save_round(model_dir, r, seed=0):
+    """Checkpoint for round ``r``; distinct seeds -> distinct weights,
+    so canary/incumbent parity differences are real."""
+    tr = Trainer(parse_config_string(NET_CFG + f"seed = {seed}\n"))
+    tr.init_model()
+    tr.round_counter = r
+    path = ckpt.model_path(str(model_dir), r)
+    tr.save_model(path)
+    return path
+
+
+def poison_round(model_dir, r, seed=0, layer="fc2"):
+    """A round whose ``<layer>/wmat`` is all-NaN — the offline gate
+    must block it and name the layer."""
+    path = save_round(model_dir, r, seed=seed)
+    blob = ckpt.load_model(path)
+    blob["params"][layer]["wmat"] = np.full_like(
+        np.asarray(blob["params"][layer]["wmat"]), np.nan)
+    tr = Trainer(parse_config_string(NET_CFG))
+    ckpt.save_model(path, params=blob["params"],
+                    net_state=blob["state"], opt_state=blob["opt"],
+                    structure_sig=tr.graph.structure_signature(),
+                    round_counter=r, epoch_counter=0)
+    return path
+
+
+def deploy_cfg(**over):
+    base = dict(window_s=5.0, backoff_s=30.0, parity_tol=1.0,
+                poll_s=0.0, max_ratio=1e9)
+    base.update(over)
+    return DeployConfig(**base)
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def make_ctl(pool, model_dir, clock, **over):
+    return DeployController(pool, str(model_dir), deploy_cfg(**over),
+                            drain_timeout_s=5.0, clock=clock)
+
+
+@pytest.fixture()
+def ledger(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    LEDGER.enable(path, new_run_id())
+    yield path
+    LEDGER.disable()
+
+
+# -- policy: validated deploy_* namespace ---------------------------------
+
+def test_deploy_config_defaults_and_parse():
+    dc = parse_deploy_config(parse_config_string(
+        "deploy_enable = 1\ndeploy_window_s = 90\n"
+        "deploy_parity_tol = 0.1\n"))
+    assert dc.enable == 1 and dc.window_s == 90.0
+    assert dc.parity_tol == 0.1
+    assert dc.backoff_s == 300.0          # untouched knobs keep defaults
+
+
+def test_deploy_config_typo_raises():
+    with pytest.raises(ConfigError, match="unknown deploy setting"):
+        parse_deploy_config(parse_config_string("deploy_windw_s = 60\n"))
+
+
+@pytest.mark.parametrize("line", [
+    "deploy_enable = 2",
+    "deploy_window_s = 0",
+    "deploy_suspect_factor = 0.5",
+    "deploy_burn_max = 0",
+    "deploy_parity_tol = 1.5",
+    "deploy_canary_replicas = 0",
+    "deploy_probe_rows = 0",
+    "deploy_backoff_s = -1",
+    "deploy_max_ratio = 0",
+    "deploy_poll_s = -1",
+])
+def test_deploy_config_bad_values_raise(line):
+    with pytest.raises(ConfigError):
+        parse_deploy_config(parse_config_string(line + "\n"))
+
+
+# -- offline gate matrix --------------------------------------------------
+
+def _blob(seed, poison_layer=None):
+    tr = Trainer(parse_config_string(NET_CFG + f"seed = {seed}\n"))
+    tr.init_model()
+    import jax
+    params = jax.device_get(tr.mesh.gather(tr.params))
+    if poison_layer:
+        params[poison_layer]["wmat"] = np.full_like(
+            np.asarray(params[poison_layer]["wmat"]), np.nan)
+    return {"meta": {"round": 0, "epoch": 0}, "params": params,
+            "state": jax.device_get(tr.mesh.gather(tr.net_state)),
+            "opt": None}
+
+
+def test_offline_gate_unsafe_names_layer():
+    g = gates.offline_gate(_blob(2, poison_layer="fc2"), _blob(1),
+                           deploy_cfg())
+    assert not g.passed
+    assert "fc2" in g.layers
+    assert g.provenance.startswith("layer=fc2 kind=param")
+
+
+def test_offline_gate_suspect_and_sane():
+    a, b = _blob(1), _blob(2)
+    g = gates.offline_gate(b, a, deploy_cfg(max_ratio=0.01))
+    assert g.passed and g.details["suspect"]   # big move: longer window
+    g = gates.offline_gate(b, a, deploy_cfg(max_ratio=1e9))
+    assert g.passed and not g.details["suspect"]
+    # no incumbent: the gate degrades to the finiteness check
+    g = gates.offline_gate(b, None, deploy_cfg())
+    assert g.passed and not g.details["suspect"]
+    g = gates.offline_gate(_blob(2, poison_layer="fc1"), None,
+                           deploy_cfg())
+    assert not g.passed and g.layers == ["fc1"]
+
+
+# -- controller: promote / block / rollback / backoff ---------------------
+
+def test_promote_on_clean_evidence(tmp_path, ledger):
+    pool = make_pool(2)
+    try:
+        clk = Clock()
+        ctl = make_ctl(pool, tmp_path, clk)
+        save_round(tmp_path, 0, seed=1)
+        assert ctl.check_once() == "canary"
+        assert ctl.snapshot()["state"] == "canary"
+        # window accounting is on the injected clock: not yet
+        assert ctl.check_once() == ""
+        clk.t += 4.9
+        assert ctl.check_once() == ""
+        clk.t += 0.2
+        assert ctl.check_once() == "promote"
+        assert {rep.version for rep in pool.replicas} == {"r0000"}
+        assert ctl.promotions == 1 and ctl.rollbacks == 0
+        assert ctl.snapshot()["state"] == "idle"
+        evs = [e for e in read_ledger(ledger)
+               if e["event"] == "deploy_promote"]
+        assert len(evs) == 1 and evs[0]["round"] == 0
+        assert evs[0]["gates"] == ["burn", "breaker", "parity"]
+    finally:
+        pool.close()
+
+
+def test_offline_unsafe_blocks_before_any_replica(tmp_path, ledger):
+    pool = make_pool(2)
+    try:
+        ctl = make_ctl(pool, tmp_path, Clock())
+        poison_round(tmp_path, 0, seed=1, layer="fc2")
+        assert ctl.check_once() == "blocked"
+        # no replica was ever touched — not even a canary
+        assert {rep.version for rep in pool.replicas} == {"init"}
+        assert ctl.incidents == 1 and ctl.rollbacks == 0
+        inc = [e for e in read_ledger(ledger)
+               if e["event"] == "deploy_incident"]
+        assert len(inc) == 1
+        assert inc[0]["gate"] == "offline"
+        assert inc[0]["rolled_back"] is False
+        # fleet-side rejection names the SAME layer the trainer-side
+        # NaN-provenance walk would name
+        assert "fc2" in inc[0]["layers"]
+        assert inc[0]["provenance"].startswith("layer=fc2")
+    finally:
+        pool.close()
+
+
+def test_suspect_extends_canary_window(tmp_path, ledger):
+    pool = make_pool(2)
+    try:
+        clk = Clock()
+        ctl = make_ctl(pool, tmp_path, clk)
+        save_round(tmp_path, 0, seed=1)
+        assert ctl.check_once() == "canary"
+        clk.t += 6
+        assert ctl.check_once() == "promote"
+        # different seed -> every leaf moved >> max_ratio -> SUSPECT
+        ctl.cfg = deploy_cfg(max_ratio=0.01, suspect_factor=3.0,
+                             backoff_s=0.0)
+        save_round(tmp_path, 1, seed=2)
+        assert ctl.check_once() == "canary"
+        assert ctl.snapshot()["canary"]["suspect"] is True
+        clk.t += 6           # past the BASE window, inside the extended
+        assert ctl.check_once() == ""
+        clk.t += 10          # past window_s * suspect_factor
+        assert ctl.check_once() == "promote"
+        assert {rep.version for rep in pool.replicas} == {"r0001"}
+    finally:
+        pool.close()
+
+
+def _online_rollback(tmp_path, pool, clk, ctl, arm):
+    """Promote round 0, canary round 1, run ``arm`` during the window,
+    then evaluate — returns the action at window close."""
+    save_round(tmp_path, 0, seed=1)
+    assert ctl.check_once() == "canary"
+    clk.t += 6
+    assert ctl.check_once() == "promote"
+    save_round(tmp_path, 1, seed=1)   # same weights: parity is clean
+    assert ctl.check_once() == "canary"
+    arm()
+    clk.t += 6
+    return ctl.check_once()
+
+
+def test_burn_gate_vetoes(tmp_path, ledger):
+    pool = make_pool(2)
+    slos = []
+    try:
+        clk = Clock()
+        ctl = make_ctl(pool, tmp_path, clk, burn_max=2.0)
+        for rep in pool.replicas:
+            slo = SLOTracker(10.0, target=0.99, window_s=30,
+                             instance=rep.engine.stats.instance,
+                             clock=lambda: clk.t)
+            slos.append(slo)
+            rep.slo = slo
+            rep.engine.stats.slo = slo
+
+        def arm():   # canary replica 0 burns its error budget
+            for _ in range(20):
+                pool.replicas[0].slo.record(ok=False)
+        assert _online_rollback(tmp_path, pool, clk, ctl, arm) \
+            == "rollback"
+        inc = [e for e in read_ledger(ledger)
+               if e["event"] == "deploy_incident"][-1]
+        assert inc["gate"] == "burn" and inc["rolled_back"] is True
+        assert {rep.version for rep in pool.replicas} == {"r0000"}
+    finally:
+        for rep, slo in zip(pool.replicas, slos):
+            slo.unregister()
+            rep.slo = rep.engine.stats.slo = None
+        pool.close()
+
+
+def test_breaker_gate_vetoes(tmp_path, ledger):
+    pool = make_pool(2)
+    try:
+        clk = Clock()
+        ctl = make_ctl(pool, tmp_path, clk)
+
+        def arm():   # canary replica's breaker trips during the window
+            br = pool.replicas[0].breaker
+            for _ in range(br.failure_threshold):
+                br.record_failure()
+        assert _online_rollback(tmp_path, pool, clk, ctl, arm) \
+            == "rollback"
+        inc = [e for e in read_ledger(ledger)
+               if e["event"] == "deploy_incident"][-1]
+        assert inc["gate"] == "breaker"
+        assert {rep.version for rep in pool.replicas} == {"r0000"}
+    finally:
+        pool.close()
+
+
+def test_parity_gate_vetoes_and_rollback_restores(tmp_path, ledger):
+    pool = make_pool(2)
+    try:
+        clk = Clock()
+        ctl = make_ctl(pool, tmp_path, clk)
+        save_round(tmp_path, 0, seed=1)
+        assert ctl.check_once() == "canary"
+        clk.t += 6
+        assert ctl.check_once() == "promote"
+        # different weights + zero tolerance: the shadow probes disagree
+        ctl.cfg = deploy_cfg(parity_tol=0.0)
+        save_round(tmp_path, 1, seed=99)
+        assert ctl.check_once() == "canary"
+        assert pool.replicas[0].version == "r0001"   # canary IS live
+        clk.t += 6
+        assert ctl.check_once() == "rollback"
+        # every replica is back on the incumbent
+        assert {rep.version for rep in pool.replicas} == {"r0000"}
+        assert ctl.rollbacks == 1 and ctl.promotions == 1
+        evs = read_ledger(ledger)
+        rb = [e for e in evs if e["event"] == "deploy_rollback"]
+        assert len(rb) == 1 and rb[0]["gate"] == "parity"
+        inc = [e for e in evs if e["event"] == "deploy_incident"][-1]
+        assert inc["gate"] == "parity" and inc["rolled_back"] is True
+        assert "disagree" in inc["reason"]
+        # the rollback reload is on the record too
+        rl = [e for e in evs if e["event"] == "weights_reload"
+              and e.get("rollback")]
+        assert rl and rl[0]["new_round"] == 0
+    finally:
+        pool.close()
+
+
+def test_backoff_prevents_recanary(tmp_path, ledger):
+    pool = make_pool(2)
+    try:
+        clk = Clock()
+        ctl = make_ctl(pool, tmp_path, clk, backoff_s=30.0)
+        save_round(tmp_path, 0, seed=1)
+        assert ctl.check_once() == "canary"
+        clk.t += 6
+        assert ctl.check_once() == "promote"
+        ctl.cfg = deploy_cfg(parity_tol=0.0, backoff_s=30.0)
+        save_round(tmp_path, 1, seed=99)
+        assert ctl.check_once() == "canary"
+        clk.t += 6
+        assert ctl.check_once() == "rollback"
+        # the rejected round is never re-canaried, even after backoff
+        clk.t += 1000
+        assert ctl.check_once() == ""
+        # a NEW round is held until the backoff expires
+        clk.t -= 1000
+        save_round(tmp_path, 2, seed=1)
+        assert ctl.check_once() == ""            # still in hold
+        clk.t += 31
+        assert ctl.check_once() == "canary"      # hold expired
+        clk.t += 6
+        assert ctl.check_once() == "promote"
+        assert {rep.version for rep in pool.replicas} == {"r0002"}
+    finally:
+        pool.close()
+
+
+def test_controller_requires_fleet(tmp_path):
+    pool = make_pool(1)
+    try:
+        with pytest.raises(ValueError, match="at least 2 replicas"):
+            DeployController(pool, str(tmp_path), deploy_cfg())
+    finally:
+        pool.close()

@@ -1,11 +1,17 @@
 #!/usr/bin/env python
-"""Offline layer-wise checkpoint health report / diff.
+"""Offline layer-wise checkpoint health report / diff (thin CLI).
 
 The offline sibling of the in-trace model-health probe
 (doc/tasks.md "Model health"): answers "is this checkpoint sane?" and
 "what changed between these two?" without loading the model into a
 trainer — the triage tool for a suspect serve hot-reload or an A/B
 canary that started misbehaving.
+
+All verdict logic lives in the library —
+``cxxnet_tpu.telemetry.modelhealth.reload_verdict`` — so in-process
+consumers (the deploy controller's offline promotion gate,
+cxxnet_tpu/deploy/gates.py) call the same code instead of shelling
+out; this file only loads checkpoints and renders tables.
 
 One checkpoint:  per-leaf RMS / abs-max / finite-fraction over params
 (and layer state), plus the same 12-hex ``checkpoint.blob_digest``
@@ -37,10 +43,9 @@ from __future__ import annotations
 
 import argparse
 import json
-import math
 import os
 import sys
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
@@ -52,11 +57,6 @@ def load(path: str, verify: bool = True):
     return blob, ckpt.blob_digest(blob["meta"])
 
 
-def report_rows(blob) -> List[Dict[str, Any]]:
-    from cxxnet_tpu.telemetry.modelhealth import layer_report
-    return layer_report(blob["params"], blob.get("state"))
-
-
 def _fmt_table(rows: List[Dict[str, Any]]) -> str:
     out = ["%-40s %-6s %12s %12s %8s" % ("leaf", "kind", "rms",
                                          "absmax", "finite%")]
@@ -65,97 +65,6 @@ def _fmt_table(rows: List[Dict[str, Any]]) -> str:
             r["leaf"], r["kind"], r["rms"], r["absmax"],
             100.0 * r["finite_frac"]))
     return "\n".join(out)
-
-
-def delta_map(blob_a, blob_b) -> Dict[Tuple[str, str], float]:
-    """Per-leaf ``rms(b - a)`` from the actual tensors, keyed like the
-    report rows — value-level changes that preserve a leaf's RMS (sign
-    flips, permutations) still register."""
-    import numpy as np
-    import jax
-    from cxxnet_tpu.telemetry.modelhealth import _leaf_key
-    out: Dict[Tuple[str, str], float] = {}
-
-    def walk(ta, tb, kind):
-        fa = {_leaf_key(p): l for p, l in
-              jax.tree_util.tree_flatten_with_path(ta)[0]}
-        fb = {_leaf_key(p): l for p, l in
-              jax.tree_util.tree_flatten_with_path(tb)[0]}
-        for k in set(fa) & set(fb):
-            a = np.asarray(fa[k], dtype=np.float64)
-            b = np.asarray(fb[k], dtype=np.float64)
-            if a.shape != b.shape or not a.size:
-                continue
-            out[(kind, k)] = float(np.sqrt(np.mean(np.square(b - a))))
-
-    walk(blob_a["params"], blob_b["params"], "param")
-    if blob_a.get("state") and blob_b.get("state"):
-        walk(blob_a["state"], blob_b["state"], "state")
-    return out
-
-
-def diff_rows(rows_a: List[Dict[str, Any]], rows_b: List[Dict[str, Any]],
-              deltas: Optional[Dict[Tuple[str, str], float]] = None
-              ) -> Tuple[List[Dict[str, Any]], List[str]]:
-    """Per-leaf relative-change rows + structural mismatch notes.
-
-    ``rel_change`` is ``rms(b - a) / rms(a)`` when ``deltas`` (from
-    :func:`delta_map`) is given; without tensors it degrades to the
-    summary-only ``|rms(b) - rms(a)| / rms(a)``."""
-    a = {(r["kind"], r["leaf"]): r for r in rows_a}
-    b = {(r["kind"], r["leaf"]): r for r in rows_b}
-    notes = []
-    for k in sorted(set(a) - set(b)):
-        notes.append("only in A: %s %s" % k)
-    for k in sorted(set(b) - set(a)):
-        notes.append("only in B: %s %s" % k)
-    out = []
-    for k in sorted(set(a) & set(b)):
-        ra, rb = a[k], b[k]
-        if ra["shape"] != rb["shape"]:
-            notes.append("shape mismatch at %s %s: %s vs %s"
-                         % (k[0], k[1], ra["shape"], rb["shape"]))
-            continue
-        denom = ra["rms"] or 1e-12
-        change = (deltas[k] if deltas is not None and k in deltas
-                  else abs(rb["rms"] - ra["rms"]))
-        out.append({"kind": k[0], "leaf": k[1],
-                    "rms_a": ra["rms"], "rms_b": rb["rms"],
-                    "rel_change": change / denom})
-    return out, notes
-
-
-def _nonfinite(rows: List[Dict[str, Any]]) -> List[str]:
-    return [r["leaf"] for r in rows if r["finite_frac"] < 1.0
-            or not math.isfinite(r["rms"])]
-
-
-def verdict(rows_a, rows_b, digest_a: str, digest_b: Optional[str],
-            max_ratio: float,
-            deltas: Optional[Dict[Tuple[str, str], float]] = None
-            ) -> Tuple[str, int]:
-    """(verdict line, exit code) — the serve-reload sanity call."""
-    bad = _nonfinite(rows_a) + (_nonfinite(rows_b) if rows_b else [])
-    if bad:
-        return ("RELOAD-UNSAFE: non-finite values in %s"
-                % ", ".join(sorted(set(bad))[:6]), 2)
-    if rows_b is None:
-        return "SANE: all leaves finite (digest %s)" % (digest_a or "-"), 0
-    diffs, notes = diff_rows(rows_a, rows_b, deltas)
-    if notes:
-        return ("RELOAD-UNSAFE: structure mismatch — "
-                + "; ".join(notes[:6]), 2)
-    if digest_b and digest_a and digest_a == digest_b:
-        return "IDENTICAL (digest %s)" % digest_a, 0
-    worst = max(diffs, key=lambda d: d["rel_change"], default=None)
-    if worst is not None and worst["rel_change"] > max_ratio:
-        return ("RELOAD-SUSPECT: %s %s moved %.3gx its RMS "
-                "(> --max-ratio %g)" % (worst["kind"], worst["leaf"],
-                                        worst["rel_change"], max_ratio),
-                1)
-    return ("RELOAD-SANE: max relative change %.3g (%s)"
-            % ((worst["rel_change"], worst["leaf"]) if worst
-               else (0.0, "-")), 0)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -172,49 +81,47 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="skip digest verification on load (a corrupt "
                          "archive then reports instead of raising)")
     args = ap.parse_args(argv)
+    from cxxnet_tpu.telemetry.modelhealth import reload_verdict
     verify = not args.no_verify
     blob_a, digest_a = load(args.ckpt_a, verify=verify)
-    rows_a = report_rows(blob_a)
-    rows_b = digest_b = deltas = None
+    blob_b = digest_b = None
     if args.ckpt_b:
         blob_b, digest_b = load(args.ckpt_b, verify=verify)
-        rows_b = report_rows(blob_b)
-        deltas = delta_map(blob_a, blob_b)
-    vline, rc = verdict(rows_a, rows_b, digest_a, digest_b,
-                        args.max_ratio, deltas)
+    res = reload_verdict(blob_a, blob_b, max_ratio=args.max_ratio,
+                         digest_a=digest_a, digest_b=digest_b or "")
+    vline, rc = res["line"], res["exit_code"]
     if args.json:
         doc: Dict[str, Any] = {
             "a": {"path": args.ckpt_a, "digest": digest_a,
-                  "round": blob_a["meta"].get("round"), "leaves": rows_a},
+                  "round": blob_a["meta"].get("round"),
+                  "leaves": res["a_leaves"]},
             "verdict": vline, "exit_code": rc,
         }
-        if rows_b is not None:
-            diffs, notes = diff_rows(rows_a, rows_b, deltas)
+        if blob_b is not None:
             doc["b"] = {"path": args.ckpt_b, "digest": digest_b,
                         "round": blob_b["meta"].get("round"),
-                        "leaves": rows_b}
-            doc["diff"] = diffs
-            doc["structure_notes"] = notes
+                        "leaves": res["b_leaves"]}
+            doc["diff"] = res["diff"]
+            doc["structure_notes"] = res["structure_notes"]
         print(json.dumps(doc, indent=1, sort_keys=True))
         return rc
     print("A: %s (round %s, digest %s)"
           % (args.ckpt_a, blob_a["meta"].get("round"), digest_a or "-"))
-    print(_fmt_table(rows_a))
-    if rows_b is not None:
+    print(_fmt_table(res["a_leaves"]))
+    if blob_b is not None:
         print()
         print("B: %s (round %s, digest %s)"
               % (args.ckpt_b, blob_b["meta"].get("round"),
                  digest_b or "-"))
-        print(_fmt_table(rows_b))
-        diffs, notes = diff_rows(rows_a, rows_b, deltas)
+        print(_fmt_table(res["b_leaves"]))
         print()
         print("%-40s %-6s %12s %12s %10s" % ("leaf", "kind", "rms A",
                                              "rms B", "rel change"))
-        for d in sorted(diffs, key=lambda d: -d["rel_change"]):
+        for d in sorted(res["diff"], key=lambda d: -d["rel_change"]):
             print("%-40s %-6s %12.5g %12.5g %10.3g"
                   % (d["leaf"], d["kind"], d["rms_a"], d["rms_b"],
                      d["rel_change"]))
-        for n in notes:
+        for n in res["structure_notes"]:
             print("! " + n)
     print()
     print(vline)

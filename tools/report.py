@@ -163,7 +163,11 @@ def section_incidents(events: List[Dict], out: List[str]) -> None:
                   "elastic_join", "elastic_leave", "topology_change",
                   "elastic_resume", "elastic_advice",
                   # model-health trail renders in its own section
-                  "model_health", "health_advice")]
+                  "model_health", "health_advice",
+                  # deployment lifecycle renders in its own timeline;
+                  # deploy_incident stays HERE — a gated rejection is
+                  # an incident, wherever it is also narrated
+                  "deploy_promote", "deploy_rollback")]
     if not incidents:
         out.append("No incidents recorded — clean run.")
         out.append("")
@@ -330,6 +334,62 @@ def section_serving(events: List[Dict], out: List[str]) -> None:
         out.append("%d replica weight swap(s); versions served: %s"
                    % (len(reloads), ", ".join(versions) or "?"))
         out.append("")
+
+
+_DEPLOY_EVENTS = ("deploy_promote", "deploy_rollback",
+                  "deploy_incident")
+
+
+def section_deployments(events: List[Dict], out: List[str]) -> None:
+    """Deployment timeline: every gated canary verdict — promotions
+    with their evidence trail, rollbacks with the vetoing gate, and
+    the incident record a rejection leaves (which ALSO appears in the
+    incident timeline: a blocked checkpoint is an incident)."""
+    deploys = [e for e in events if e.get("event") in _DEPLOY_EVENTS]
+    if not deploys:
+        return
+    out.append("## Deployments")
+    out.append("")
+    for e in deploys[:200]:
+        etype = e.get("event")
+        line = "- %s `h%s` **%s**" % (_ts(e.get("ts")),
+                                      e.get("host", 0), etype)
+        if etype == "deploy_promote":
+            line += ": %s (digest `%s`) after %ss window%s — gates %s" \
+                % (e.get("version", "?"), e.get("digest", "?"),
+                   e.get("window_s", "?"),
+                   " (SUSPECT-extended)" if e.get("suspect") else "",
+                   ", ".join(e.get("gates", [])) or "?")
+            if e.get("canary_requests"):
+                line += "; canary served %s request(s), %s failed" % (
+                    e["canary_requests"], e.get("canary_failed", 0))
+        elif etype == "deploy_rollback":
+            line += ": %s rolled back to r%s — **%s** gate vetoed" % (
+                e.get("version", "?"), e.get("incumbent_round", "?"),
+                e.get("gate", "?"))
+        elif etype == "deploy_incident":
+            line += ": round %s (digest `%s`) rejected by **%s** gate" \
+                % (e.get("round", "?"), e.get("digest", "?"),
+                   e.get("gate", "?"))
+            if e.get("layers"):
+                line += ", layers %s" % ",".join(e["layers"])
+            if e.get("reason"):
+                line += " — %s" % e["reason"]
+            if e.get("trace_ids"):
+                line += " (traces: %s)" % ", ".join(
+                    "`%s`" % t for t in e["trace_ids"][:4])
+        out.append(line)
+    out.append("")
+    promos = sum(1 for e in deploys
+                 if e.get("event") == "deploy_promote")
+    rolls = sum(1 for e in deploys
+                if e.get("event") == "deploy_rollback")
+    blocked = sum(1 for e in deploys
+                  if e.get("event") == "deploy_incident"
+                  and not e.get("rolled_back"))
+    out.append("%d promotion(s), %d rollback(s), %d blocked "
+               "offline." % (promos, rolls, blocked))
+    out.append("")
 
 
 _ELASTIC_EVENTS = ("elastic_join", "elastic_leave", "topology_change",
@@ -605,6 +665,7 @@ def generate(ledger_path: str, telemetry_log: Optional[str],
     section_incidents(events, out)
     section_modelhealth(events, out)
     section_serving(events, out)
+    section_deployments(events, out)
     section_topology(events, out)
     section_checkpoints(events, out)
     section_critical_path(cp, out)

@@ -1,0 +1,263 @@
+#!/usr/bin/env python
+"""Closed-loop deployment smoke check (CPU-safe): chaos rounds under load.
+
+End-to-end proof of the deploy/ controller's promise, on 2 faked CPU
+devices with live open-loop traffic the whole way through:
+
+  1. train round 0, checkpoint it, bring up a 2-replica pool ON that
+     blob behind the HTTP server with the DeployController attached
+     (manual ticks: ``deploy_poll_s = 0``);
+  2. GOOD round — train one more epoch, publish round 1: the
+     controller must canary it, hold the window under live traffic,
+     pass every gate and promote BOTH replicas onto it (exactly one
+     ``deploy_promote``);
+  3. POISONED round — a ``device.step`` failpoint with
+     ``CXXNET_NAN_LAYER=fc2`` NaNs exactly one layer inside the
+     TRAINER, whose own provenance walk (``diagnose_nonfinite``)
+     names it; round 2 is published and the controller's OFFLINE gate
+     must block it before any replica is touched, and the
+     ``deploy_incident`` must name the SAME layer the trainer named;
+  4. REGRESSED round — round 1's weights with ``fc2`` negated (finite,
+     structurally identical, argmax inverted): the offline gate passes
+     it to a canary, the PARITY gate must veto at window close, and
+     the canary must roll back to the incumbent (final fleet: all
+     replicas on r0001);
+  5. throughout: ZERO failed or rejected requests (loadgen result AND
+     the ``/statz`` counters), and the ledger tells the whole story —
+     one ``deploy_promote``, one ``deploy_rollback``, two
+     ``deploy_incident`` records.
+
+Exits nonzero on any failure.
+Run:  JAX_PLATFORMS=cpu python tools/smoke_deploy.py
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+
+NET_CFG = """
+netconfig=start
+layer[+1:h1] = fullc:fc1
+  nhidden = 32
+  random_type = xavier
+layer[+1:a1] = relu
+layer[a1->out] = fullc:fc2
+  nhidden = 5
+  random_type = xavier
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,16
+batch_size = 64
+eta = 0.3
+dev = cpu
+eval_train = 0
+"""
+
+SYN_ITER = """
+iter = synthetic
+num_inst = 512
+batch_size = 64
+num_class = 5
+input_shape = 1,1,16
+seed_data = 3
+"""
+
+
+def _tick_until(ctl, want: str, timeout_s: float = 30.0) -> None:
+    """Drive manual control-loop ticks until ``want`` happens; any
+    OTHER action is a wrong verdict and fails immediately."""
+    deadline = time.perf_counter() + timeout_s
+    while time.perf_counter() < deadline:
+        a = ctl.check_once()
+        if a == want:
+            return
+        assert a == "", \
+            f"controller took {a!r} while waiting for {want!r}"
+        time.sleep(0.1)
+    raise AssertionError(f"controller never reached {want!r}: "
+                         f"{ctl.snapshot()}")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--qps", type=float, default=20.0,
+                    help="open-loop target QPS (default 20)")
+    ap.add_argument("--duration", type=float, default=22.0,
+                    help="open-loop seconds (default 22)")
+    args = ap.parse_args()
+
+    import numpy as np
+    from cxxnet_tpu.config import parse_config_string
+    from cxxnet_tpu.io.data import create_iterator
+    from cxxnet_tpu.trainer import Trainer
+    from cxxnet_tpu import checkpoint as ckpt
+    from cxxnet_tpu.deploy import DeployController, parse_deploy_config
+    from cxxnet_tpu.resilience import failpoints
+    from cxxnet_tpu.serve import ReplicaPool
+    from cxxnet_tpu.serve.server import ServeServer
+    from cxxnet_tpu.telemetry.ledger import LEDGER, new_run_id
+    from cxxnet_tpu.telemetry.modelhealth import diagnose_nonfinite
+    from tools import loadgen
+
+    with tempfile.TemporaryDirectory() as td:
+        model_dir = os.path.join(td, "models")
+        os.makedirs(model_dir)
+        ledger_path = os.path.join(td, "deploy.ledger.jsonl")
+        LEDGER.enable(ledger_path, new_run_id())
+
+        def train_epoch(tr):
+            for batch in create_iterator(parse_config_string(SYN_ITER)):
+                tr.update(batch)
+
+        # round 0 -> the fleet's starting version
+        tr = Trainer(parse_config_string(NET_CFG))
+        tr.init_model()
+        train_epoch(tr)
+        tr.round_counter = 0
+        tr.save_model(ckpt.model_path(model_dir, 0))
+
+        blob0 = ckpt.load_for_inference(ckpt.model_path(model_dir, 0))
+        pool = ReplicaPool.build(
+            NET_CFG, 2, blob=blob0,
+            digest=ckpt.blob_digest(blob0["meta"]),
+            buckets="2,4,8", max_batch=8, max_latency_ms=10, slo_ms=0)
+        dc = parse_deploy_config(parse_config_string(
+            "deploy_enable = 1\n"
+            "deploy_poll_s = 0\n"          # manual ticks from this thread
+            "deploy_window_s = 2\n"
+            "deploy_parity_tol = 0.5\n"    # one epoch of drift is fine
+            "deploy_probe_rows = 64\n"
+            "deploy_backoff_s = 0.5\n"
+            "deploy_max_ratio = 100\n"))   # SUSPECT path covered by tests
+        ctl = DeployController(pool, model_dir, dc, drain_timeout_s=10)
+        srv = ServeServer(pool=pool, reload_watcher=ctl, port=0,
+                          log_interval_s=0, silent=True,
+                          handle_signals=False).start()
+        url = f"http://127.0.0.1:{srv.port}"
+        try:
+            # live open-loop traffic for the WHOLE chaos dance
+            bench: dict = {}
+
+            def run_load():
+                bench.update(loadgen.run_bench(
+                    url, mode="open", qps=args.qps,
+                    duration_s=args.duration, rows=1, width=16,
+                    warmup_s=1.0,
+                    note="CPU smoke (tools/smoke_deploy.py): "
+                         "session estimate, no accelerator attached"))
+
+            lt = threading.Thread(target=run_load)
+            lt.start()
+
+            # ---- GOOD round: canary -> window -> promote ------------
+            train_epoch(tr)
+            tr.round_counter = 1
+            tr.save_model(ckpt.model_path(model_dir, 1))
+            _tick_until(ctl, "canary")
+            _tick_until(ctl, "promote")
+            vers = {rep.version for rep in pool.replicas}
+            assert vers == {"r0001"}, f"fleet not promoted: {vers}"
+            assert ctl.promotions == 1, ctl.snapshot()
+
+            # ---- POISONED round: trainer-side NaN, offline block ----
+            os.environ["CXXNET_NAN_LAYER"] = "fc2"
+            failpoints.configure("device.step=every:1")
+            try:
+                tr.update(next(iter(create_iterator(
+                    parse_config_string(SYN_ITER)))))
+            finally:
+                failpoints.clear()
+                os.environ.pop("CXXNET_NAN_LAYER", None)
+            trainer_prov = diagnose_nonfinite(tr) or ""
+            assert trainer_prov.startswith("layer=fc2 kind=param"), \
+                f"trainer provenance walk missed the poison: " \
+                f"{trainer_prov!r}"
+            tr.round_counter = 2
+            tr.save_model(ckpt.model_path(model_dir, 2))
+            _tick_until(ctl, "blocked")
+            vers = {rep.version for rep in pool.replicas}
+            assert vers == {"r0001"}, \
+                f"a poisoned round touched the fleet: {vers}"
+
+            # ---- REGRESSED round: finite garbage, parity veto -------
+            blob1 = ckpt.load_model(ckpt.model_path(model_dir, 1))
+            bad = dict(blob1["params"])
+            bad["fc2"] = {k: -np.asarray(v)
+                          for k, v in blob1["params"]["fc2"].items()}
+            ckpt.save_model(ckpt.model_path(model_dir, 3),
+                            params=bad, net_state=blob1["state"],
+                            opt_state=blob1["opt"],
+                            structure_sig=tr.graph.structure_signature(),
+                            round_counter=3, epoch_counter=0)
+            time.sleep(dc.backoff_s + 0.2)   # let the NaN backoff lapse
+            _tick_until(ctl, "canary")
+            _tick_until(ctl, "rollback")
+            vers = {rep.version for rep in pool.replicas}
+            assert vers == {"r0001"}, \
+                f"rollback did not restore the incumbent: {vers}"
+            assert ctl.promotions == 1 and ctl.rollbacks == 1, \
+                ctl.snapshot()
+
+            lt.join()
+
+            # ---- zero failed requests through ALL of the above ------
+            assert bench.get("failures") == 0, \
+                f"loadgen saw failures: {bench.get('phases')}"
+            win = bench["open_window"]
+            assert win["failed"] == 0 and win["rejected"] == 0, win
+            s = srv.statz()
+            assert s["requests"]["failed"] == 0, s["requests"]
+            assert s["reload"]["state"] == "idle", s["reload"]
+
+            # ---- the ledger tells the whole story -------------------
+            events = [json.loads(l) for l in open(ledger_path)
+                      if l.strip()]
+            promos = [e for e in events
+                      if e["event"] == "deploy_promote"]
+            rolls = [e for e in events
+                     if e["event"] == "deploy_rollback"]
+            incs = [e for e in events
+                    if e["event"] == "deploy_incident"]
+            assert len(promos) == 1 and promos[0]["round"] == 1, promos
+            assert len(rolls) == 1 and rolls[0]["round"] == 3 \
+                and rolls[0]["gate"] == "parity", rolls
+            assert len(incs) == 2, incs
+            nan_inc = [e for e in incs if e["round"] == 2][0]
+            assert nan_inc["gate"] == "offline" \
+                and not nan_inc["rolled_back"], nan_inc
+            # the fleet-side rejection and the trainer-side provenance
+            # walk name the SAME layer
+            assert nan_inc["layers"] == ["fc2"], nan_inc
+            t_layer = trainer_prov.split()[0]
+            assert nan_inc["provenance"].split()[0] == t_layer, \
+                (trainer_prov, nan_inc["provenance"])
+            par_inc = [e for e in incs if e["round"] == 3][0]
+            assert par_inc["gate"] == "parity" \
+                and par_inc["rolled_back"], par_inc
+
+            print("smoke_deploy OK:", json.dumps({
+                "promotions": ctl.promotions,
+                "rollbacks": ctl.rollbacks,
+                "incidents": ctl.incidents,
+                "final_versions": sorted(vers),
+                "nan_layer": nan_inc["layers"],
+                "qps_sustained": bench["qps_sustained"],
+                "p99_ms": bench["p99_ms"]}))
+        finally:
+            srv.stop()
+            LEDGER.disable()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
