@@ -493,7 +493,9 @@ def _sweep_orphans(model_dir: str, verbose: bool) -> None:
             pass                 # racing writer/reader; leave it be
 
 
-def rotate_checkpoints(model_dir: str, keep_last_n: int) -> List[str]:
+def rotate_checkpoints(model_dir: str, keep_last_n: int,
+                       pin_rounds=(), keep_incident_rounds: int = 2
+                       ) -> List[str]:
     """Delete all but the newest ``keep_last_n`` checkpoints (0 = keep
     everything). Returns the deleted paths. Deletion failures are
     non-fatal — rotation is hygiene, not correctness. A shard-set round
@@ -504,18 +506,37 @@ def rotate_checkpoints(model_dir: str, keep_last_n: int) -> List[str]:
     mid-rotation leaves a manifest-less stale pile the orphan sweep
     reclaims (a manifest-ful half-deleted dir would be re-scanned and
     re-rejected forever) — then the shard files, then the empty
-    directory."""
+    directory.
+
+    ``pin_rounds`` exempts incident-referenced rounds from rotation:
+    a sentinel rollback restores round ``r0`` and the replay tooling
+    (``tools/replay.py``) later needs that exact checkpoint — rotation
+    deleting it would make the ledger incident unreplayable. Pinned
+    rounds do NOT consume the ``keep_last_n`` budget; the newest
+    ``keep_incident_rounds`` distinct pins are honored (0 disables
+    pinning) so a rollback loop cannot grow retention without bound."""
     if keep_last_n <= 0:
         return []
+    pinned = set()
+    if keep_incident_rounds > 0:
+        pinned = set(sorted({int(r) for r in pin_rounds},
+                            reverse=True)[:keep_incident_rounds])
     deleted = []
     # retention is promised in ROUNDS, not directory entries: a round
     # present in BOTH formats (a run that flipped shard_ckpt) counts
     # once, and both its representations are kept or dropped together
     kept_rounds: set = set()
+    kept_fresh = 0
     victims = []
     for r, path in _scan_rounds(model_dir):
-        if r in kept_rounds or len(kept_rounds) < keep_last_n:
+        if r in kept_rounds:
+            continue
+        if r in pinned:
             kept_rounds.add(r)
+            continue
+        if kept_fresh < keep_last_n:
+            kept_rounds.add(r)
+            kept_fresh += 1
             continue
         victims.append(path)
     for path in victims:

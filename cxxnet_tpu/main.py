@@ -28,7 +28,7 @@ from .io.data import DataBatch, close_chain, create_iterator
 from .resilience import SentinelAbort, TrainingSentinel, counters, failpoints
 from .telemetry import TelemetrySession
 from .telemetry.disttrace import DISTTRACE, set_trace_identity
-from .telemetry.ledger import LEDGER, config_hash
+from .telemetry.ledger import LEDGER, config_hash, plan_config_snapshot
 from .telemetry.trace import NULL_SPAN, TRACER
 from .trainer import Trainer
 from . import checkpoint as ckpt
@@ -126,8 +126,13 @@ class LearnTask:
         # transient-IO retry knobs for every remote stream op
         from .io import stream
         stream.set_retry_policy(parse_retry_policy(self.global_cfg))
-        # checkpoint hygiene: keep only the newest N (0 = keep all)
+        # checkpoint hygiene: keep only the newest N (0 = keep all);
+        # rounds a sentinel rollback restored stay pinned from rotation
+        # (newest keep_incident_rounds of them, 0 disables) so ledger
+        # incidents remain replayable after retention trims the rest
         self.keep_last_n = int(gp("keep_last_n", "0"))
+        self.keep_incident_rounds = int(gp("keep_incident_rounds", "2"))
+        self._incident_rounds: List[int] = []
         # sharded checkpointing + persistent compile cache (doc/tasks.md
         # "Sharded checkpointing"): shard_ckpt routes through the
         # Trainer's knob; compile_cache_dir is enabled below once the
@@ -275,10 +280,17 @@ class LearnTask:
             self.telemetry.watchdog.progress_fn = \
                 lambda: tr._step_count
         # run_start anchors the ledger: identity + config + the mesh
-        # this process actually brought up
+        # this process actually brought up. The replay fields — the
+        # RESOLVED config snapshot (post-parse, post-CLI-override; the
+        # env-armed failpoints recorded separately below since they
+        # never enter cfg), the armed failpoint spec + its seed/target
+        # env, and the data-service addressing seed — are everything
+        # replay/reconstruct.py needs to rebuild this run's exact
+        # batch-address and fault schedule in one local process.
         from .parallel import mesh as mesh_mod
         from .compile_cache import cache_dir
         m = self.trainer.mesh
+        snap_fields, snap_chunks = plan_config_snapshot(self.cfg)
         LEDGER.event(
             "run_start", task=self.task,
             config_hash=self.telemetry.cfg_hash,
@@ -288,7 +300,19 @@ class LearnTask:
             mesh={"data": m.data_parallel, "seq": m.seq_parallel,
                   "pipe": m.pipeline_parallel, "model": m.model_parallel},
             dist=mesh_mod.LAST_DIST_INIT,
-            compile_cache=cache_dir())
+            compile_cache=cache_dir(),
+            failpoints=failpoints.active(),
+            failpoint_seed=int(os.environ.get(
+                failpoints.SEED_ENV_VAR, "0") or "0"),
+            nan_layer=os.environ.get("CXXNET_NAN_LAYER", ""),
+            data_service_seed=self.data_service.seed,
+            data_service_shards=(
+                (self.data_service.shards
+                 or len(self.data_service.endpoint_list))
+                if self.data_service.enabled else 0),
+            **snap_fields)
+        for ch in snap_chunks:
+            LEDGER.event("config_chunk", **ch)
 
     # -- iterators ---------------------------------------------------------
     def _make_iter(self, pairs: ConfigPairs):
@@ -816,8 +840,13 @@ class LearnTask:
             if prov:
                 sentinel.annotate_last(prov)
                 reason = f"{reason} [{prov}]"
+        # step + observed losses make the trip REPLAYABLE: replay
+        # re-executes the window and compares this exact step's loss
+        # vector (NaN sanitizes to null — a null slot means "non-finite
+        # here", which replay asserts positionally)
         LEDGER.event("sentinel_trip", round=r, reason=reason,
-                     provenance=prov)
+                     provenance=prov, step=tr._step_count,
+                     losses=vals)
         # drain any in-flight async checkpoint write BEFORE scanning —
         # a failed one degrades (counted) exactly like a sync failure,
         # and the scan must not race a live writer. No tmp sweep here:
@@ -856,8 +885,12 @@ class LearnTask:
             # params
             hp.reset_after_rollback()
         counters.inc("sentinel.rollbacks")
+        # pin the restored round from rotation: the ledger incident
+        # references it and tools/replay.py must still find it on disk
+        # (bounded by keep_incident_rounds in _save_round)
+        self._incident_rounds.append(r0)
         LEDGER.event("rollback", round=r, to_round=r0, path=path,
-                     reason=reason, provenance=prov,
+                     reason=reason, provenance=prov, step=tr._step_count,
                      lr_scale=float(tr.optimizer.lr_scale))
         if not self.silent:
             print(f"sentinel: {reason}; rolled back to round {r0} "
@@ -890,7 +923,10 @@ class LearnTask:
                       "(next save period retries)", flush=True)
             return
         if self.keep_last_n:
-            ckpt.rotate_checkpoints(self.model_dir, self.keep_last_n)
+            ckpt.rotate_checkpoints(
+                self.model_dir, self.keep_last_n,
+                pin_rounds=self._incident_rounds,
+                keep_incident_rounds=self.keep_incident_rounds)
 
     def _timed_batches(self, it, probe):
         """Wrap a batch source so each fetch's host-blocked time is
@@ -1114,7 +1150,8 @@ class LearnTask:
                 r, images=n_images, batches=batch_count,
                 seconds=round(dt_round, 3),
                 images_per_sec=round(n_images / dt_round, 2),
-                loss=tr.last_loss if batch_count else None)
+                loss=tr.last_loss if batch_count else None,
+                step_count=tr._step_count)
             # the metric line always prints on the root rank, even under
             # silent=1 (reference emits it via TrackerPrint regardless)
             if self._is_root:

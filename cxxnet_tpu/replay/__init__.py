@@ -1,0 +1,23 @@
+"""Deterministic incident replay — time-travel debugging for fleet
+incidents (doc/tasks.md "Incident replay").
+
+``reconstruct`` turns a ledger incident into a ReplayPlan (exact
+resolved config, checkpoint round, data-address window, failpoint
+spec); ``execute`` re-runs the window in THIS process and verdicts
+``bit_exact`` / ``diverged_at_step`` / ``unreproducible:<reason>``.
+CLI: ``python tools/replay.py <ledger> [--incident N|--last]``.
+"""
+
+from .executor import ReplayResult, execute
+from .reconstruct import (INCIDENT_EVENTS, ConfigDriftError,
+                          ReconstructError, ReplayConfig, ReplayPlan,
+                          compensate_failpoints, diff_config,
+                          list_incidents, parse_replay_config,
+                          reconstruct)
+
+__all__ = [
+    "INCIDENT_EVENTS", "ConfigDriftError", "ReconstructError",
+    "ReplayConfig", "ReplayPlan", "ReplayResult",
+    "compensate_failpoints", "diff_config", "execute",
+    "list_incidents", "parse_replay_config", "reconstruct",
+]

@@ -22,6 +22,15 @@ Modes per site:
                   floats like ``0.01`` are shorthand for ``prob:0.01``);
 * ``off``       — explicit no-op (overrides an env entry).
 
+Mid-stream alignment (incident replay, ``cxxnet_tpu/replay``): a
+replayed process starts its check counters at 0 while the original
+fired relative to process start, so both periodic modes accept an
+offset suffix — ``every:N@P`` fires when ``(checks + P) % N == 0``
+(arm with ``P = start_step % N`` to reproduce the original cadence
+from a checkpoint at ``start_step``), and ``prob:p@K`` discards the
+first ``K`` draws of the per-site RNG before the first check (the
+draw stream position of a run that already made ``K`` checks).
+
 Sites installed in this codebase:
 
 ====================  =====================================================
@@ -53,6 +62,7 @@ Sites installed in this codebase:
 
 from __future__ import annotations
 
+import hashlib
 import os
 import random
 import threading
@@ -71,17 +81,28 @@ class FailpointSpecError(ValueError):
 
 
 class _Site:
-    __slots__ = ("name", "mode", "n", "p", "rng", "checks", "fires")
+    __slots__ = ("name", "mode", "n", "p", "rng", "checks", "fires",
+                 "phase", "skip")
 
     def __init__(self, name: str, mode: str, n: int = 0, p: float = 0.0,
-                 seed: int = 0):
+                 seed: int = 0, phase: int = 0, skip: int = 0):
         self.name = name
         self.mode = mode          # "once" | "every" | "prob"
         self.n = n
         self.p = p
+        self.phase = phase        # every:N@P — replayed-counter offset
+        self.skip = skip          # prob:p@K — draws already consumed
         # per-site seeded RNG: prob-mode fire sequences are reproducible
-        # run-to-run (chaos tests must never be flaky)
-        self.rng = random.Random((hash(name) & 0xFFFFFFFF) ^ seed)
+        # run-to-run (chaos tests must never be flaky). The python
+        # string hash is salted per process (PYTHONHASHSEED), which
+        # would make "reproducible" a lie across processes — and replay
+        # runs in a DIFFERENT process than the run it reproduces — so
+        # derive the per-site salt from a stable digest instead.
+        site_salt = int.from_bytes(
+            hashlib.sha256(name.encode("utf-8")).digest()[:4], "big")
+        self.rng = random.Random(site_salt ^ seed)
+        for _ in range(skip):
+            self.rng.random()
         self.checks = 0
         self.fires = 0
 
@@ -90,7 +111,7 @@ class _Site:
         if self.mode == "once":
             return self.checks == 1
         if self.mode == "every":
-            return self.checks % self.n == 0
+            return (self.checks + self.phase) % self.n == 0
         return self.rng.random() < self.p     # "prob"
 
 
@@ -101,17 +122,32 @@ def _parse_mode(name: str, mode: str, seed: int) -> Optional[_Site]:
     if mode == "once":
         return _Site(name, "once", seed=seed)
     if mode.startswith("every:"):
+        body, _, ph = mode[6:].partition("@")
         try:
-            n = int(mode[6:])
+            n = int(body)
+            phase = int(ph) if ph else 0
         except ValueError:
             raise FailpointSpecError(
-                f"failpoint {name}: bad every:N count {mode[6:]!r}")
+                f"failpoint {name}: bad every:N[@P] spec {mode[6:]!r}")
         if n < 1:
             raise FailpointSpecError(
                 f"failpoint {name}: every:N needs N >= 1, got {n}")
-        return _Site(name, "every", n=n, seed=seed)
+        if phase < 0:
+            raise FailpointSpecError(
+                f"failpoint {name}: every:N@P needs P >= 0, got {phase}")
+        return _Site(name, "every", n=n, seed=seed, phase=phase % n)
+    skip = 0
     if mode.startswith("prob:"):
-        mode = mode[5:]
+        mode, _, sk = mode[5:].partition("@")
+        if sk:
+            try:
+                skip = int(sk)
+            except ValueError:
+                raise FailpointSpecError(
+                    f"failpoint {name}: bad prob:p@K skip {sk!r}")
+            if skip < 0:
+                raise FailpointSpecError(
+                    f"failpoint {name}: prob:p@K needs K >= 0, got {skip}")
     try:
         p = float(mode)
     except ValueError:
@@ -121,7 +157,7 @@ def _parse_mode(name: str, mode: str, seed: int) -> Optional[_Site]:
     if not 0.0 <= p <= 1.0:
         raise FailpointSpecError(
             f"failpoint {name}: probability {p} outside [0, 1]")
-    return _Site(name, "prob", p=p, seed=seed)
+    return _Site(name, "prob", p=p, seed=seed, skip=skip)
 
 
 class Failpoints:
@@ -203,12 +239,20 @@ class Failpoints:
             return self._fired.get(name, 0)
 
     def active(self) -> Dict[str, str]:
+        """The armed spec, one re-parseable ``mode`` string per site —
+        what the run ledger records on ``run_start`` so incident replay
+        can re-arm the exact fault schedule."""
         with self._lock:
             out = {}
             for name, s in self._sites.items():
-                out[name] = (s.mode if s.mode == "once"
-                             else f"every:{s.n}" if s.mode == "every"
-                             else f"prob:{s.p}")
+                if s.mode == "once":
+                    out[name] = "once"
+                elif s.mode == "every":
+                    out[name] = (f"every:{s.n}@{s.phase}" if s.phase
+                                 else f"every:{s.n}")
+                else:
+                    out[name] = (f"prob:{s.p}@{s.skip}" if s.skip
+                                 else f"prob:{s.p}")
             return out
 
     # -- the hot call ----------------------------------------------------

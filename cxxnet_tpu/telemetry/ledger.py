@@ -78,6 +78,10 @@ KNOWN_EVENTS = (
     # closed-loop deployment (deploy/controller.py): gated canary
     # promotions, rollbacks, and the incident record a rejection leaves
     "deploy_promote", "deploy_rollback", "deploy_incident",
+    # incident replay (replay/, tools/replay.py): config_chunk carries
+    # an oversized run_start config snapshot split across lines;
+    # replay_start/replay_verdict are the re-execution's own record
+    "config_chunk", "replay_start", "replay_verdict",
 )
 
 
@@ -277,25 +281,95 @@ def run_info() -> Dict[str, str]:
     return dict(RUN_INFO)
 
 
+# -- config snapshot (incident replay) ---------------------------------------
+
+# inline budget for the run_start config snapshot: the atomic-line
+# bound minus generous headroom for the envelope and the other
+# run_start fields (mesh, dist, cache paths). Oversized configs split
+# into config_chunk events of at most this payload each.
+_SNAPSHOT_INLINE_BYTES = 2600
+
+
+def plan_config_snapshot(pairs) -> Tuple[Dict[str, Any],
+                                         List[Dict[str, Any]]]:
+    """Split the resolved config snapshot for ledger recording.
+
+    Returns ``(run_start_fields, chunk_events)``: when the snapshot
+    fits one atomic line it rides ``run_start`` directly as
+    ``config=[[k, v], ...]`` (order preserved — this config dialect is
+    order-sensitive) and the chunk list is empty; otherwise
+    ``run_start`` carries ``config_chunks=N`` and each returned chunk
+    dict (``seq``/``total``/``pairs``) is emitted as its own
+    ``config_chunk`` event. ``replay/reconstruct.py`` reassembles and
+    cross-checks :func:`config_hash` against the one ``run_start``
+    recorded, so a snapshot the truncation path mangled fails loudly
+    instead of replaying the wrong config."""
+    pairs = [[str(k), str(v)] for k, v in pairs]
+    payload = json.dumps(pairs)
+    if len(payload.encode("utf-8")) <= _SNAPSHOT_INLINE_BYTES:
+        return {"config": pairs}, []
+    chunks: List[List[List[str]]] = []
+    cur: List[List[str]] = []
+    cur_bytes = 0
+    for kv in pairs:
+        b = len(json.dumps(kv).encode("utf-8")) + 2
+        if cur and cur_bytes + b > _SNAPSHOT_INLINE_BYTES:
+            chunks.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(kv)
+        cur_bytes += b
+    if cur:
+        chunks.append(cur)
+    total = len(chunks)
+    return ({"config_chunks": total},
+            [{"seq": i, "total": total, "pairs": c}
+             for i, c in enumerate(chunks)])
+
+
 # -- reading ------------------------------------------------------------------
 
-def iter_ledger(path: str) -> Iterator[Dict[str, Any]]:
+def iter_ledger(path: str, warn: bool = True) -> Iterator[Dict[str, Any]]:
     """Yield parsed events; malformed lines (torn tail writes, stray
     garbage) are SKIPPED, unknown event types and extra fields pass
-    through — open-world reads by contract."""
-    with open(path, "r", encoding="utf-8") as f:
-        for line in f:
-            line = line.strip()
-            if not line:
+    through — open-world reads by contract.
+
+    The file is read as BYTES and each line decoded individually: a
+    writer SIGKILLed mid-write (exactly when the ledger gets read —
+    the chaos smokes produce these) can tear the final line anywhere,
+    including inside a multi-byte UTF-8 sequence, and a text-mode line
+    iterator would raise UnicodeDecodeError from the read itself,
+    outside any per-line handling. Every skip is counted
+    (``cxxnet_ledger_read_drops_total``) and summarized with one
+    warning per call (``warn=False`` silences it, not the counter)."""
+    drops = 0
+    with open(path, "rb") as f:
+        for raw in f:
+            raw = raw.strip()
+            if not raw:
                 continue
             try:
-                rec = json.loads(line)
+                # json.loads decodes the bytes itself; UnicodeDecodeError
+                # is a ValueError subclass, so one except covers torn
+                # UTF-8 and torn JSON alike
+                rec = json.loads(raw)
             except ValueError:
+                drops += 1
                 continue
             if not isinstance(rec, dict) or "event" not in rec:
+                drops += 1
                 continue
             yield rec
+    if drops:
+        REGISTRY.counter(
+            "cxxnet_ledger_read_drops_total",
+            "Malformed ledger lines skipped on read (torn tail writes)"
+        ).inc(drops)
+        if warn:
+            import sys
+            print(f"WARNING: ledger {path}: skipped {drops} malformed "
+                  "line(s) (torn tail write?)", file=sys.stderr,
+                  flush=True)
 
 
-def read_ledger(path: str) -> List[Dict[str, Any]]:
-    return list(iter_ledger(path))
+def read_ledger(path: str, warn: bool = True) -> List[Dict[str, Any]]:
+    return list(iter_ledger(path, warn=warn))

@@ -221,6 +221,48 @@ def test_rotation_counts_rounds_not_entries(tmp_path):
 
 
 @pytest.mark.quick
+def test_rotation_pins_incident_rounds(tmp_path):
+    """A round an unresolved ledger incident rolled back to must
+    survive rotation (tools/replay.py has to find it), WITHOUT eating
+    into the keep_last_n freshness budget."""
+    td = str(tmp_path)
+    for r in range(6):
+        _save(td, r, seed=r)
+    deleted = ckpt.rotate_checkpoints(td, 2, pin_rounds=[1])
+    # newest 2 (4, 5) kept on budget, round 1 kept on the pin
+    assert sorted(os.path.basename(p) for p in deleted) \
+        == ["r0000", "r0002", "r0003"]
+    assert os.path.exists(os.path.join(td, "r0001"))
+    assert os.path.exists(os.path.join(td, "r0004"))
+    assert os.path.exists(os.path.join(td, "r0005"))
+
+
+@pytest.mark.quick
+def test_rotation_pin_bound_and_repeats(tmp_path):
+    """keep_incident_rounds bounds the pin set to the NEWEST distinct
+    incident rounds; duplicate pins (repeated rollbacks onto one
+    round) count once; keep_incident_rounds=0 disables pinning."""
+    td = str(tmp_path)
+    for r in range(6):
+        _save(td, r, seed=r)
+    deleted = ckpt.rotate_checkpoints(
+        td, 1, pin_rounds=[0, 0, 1, 3], keep_incident_rounds=2)
+    # budget keeps 5; pins bounded to the newest two (1, 3); 0 falls
+    assert sorted(os.path.basename(p) for p in deleted) \
+        == ["r0000", "r0002", "r0004"]
+    for r in (1, 3, 5):
+        assert os.path.exists(os.path.join(td, f"r{r:04d}"))
+    # pinning disabled: plain keep_last_n semantics
+    td2 = os.path.join(td, "nopin")
+    for r in range(3):
+        _save(td2, r, seed=r)
+    ckpt.rotate_checkpoints(td2, 1, pin_rounds=[0],
+                            keep_incident_rounds=0)
+    assert not os.path.exists(os.path.join(td2, "r0000"))
+    assert os.path.exists(os.path.join(td2, "r0002"))
+
+
+@pytest.mark.quick
 def test_sweep_spares_live_reaps_stale(tmp_path):
     td = str(tmp_path)
     _save(td, 0, seed=0)

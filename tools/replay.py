@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Deterministic incident replay CLI — time-travel back into a ledger
+incident and re-execute it in THIS process, bit-exact.
+
+Point it at a run ledger: it reconstructs the exact (resolved config,
+checkpoint round, data-address window, failpoint spec) for an incident
+(sentinel_trip / rollback / deploy_incident / dataservice_degrade /
+straggler), rebuilds the trainer at local width from the newest
+verified checkpoint at-or-before the incident, re-runs the offending
+steps with health=1 through the deterministic local data path, and
+verdicts the re-execution against the record:
+
+  bit_exact                 every compared loss (and, with --failpoints
+                            on, the NaN step + layer=/kind= provenance)
+                            matched bitwise
+  diverged_at_step          first mismatching step is named
+  unreproducible:<reason>   the window could not be re-executed
+                            (config drift, missing checkpoint, torn
+                            snapshot, data addressing changed, ...)
+
+Usage:
+  python tools/replay.py <ledger.jsonl> [--list]
+      [--incident N | --last] [--failpoints on|off] [--steps K]
+      [--model-dir DIR] [--config FILE] [--out-ledger PATH]
+      [--no-strict] [key=value ...]
+
+  --list            print the replayable incidents and exit
+  --incident N      replay incident N (index from --list / the report's
+                    incident timeline); default: the last one
+  --failpoints on   re-arm the recorded failpoint spec, step-compensated
+                    to the replay window (reproduces the recorded NaN
+                    with identical provenance); default off = clean
+                    counterfactual re-execution
+  --steps K         cap the replay at K steps
+  --config FILE     diff the recorded snapshot against this live config
+                    tree (loud unreproducible:config-drift on mismatch)
+  --model-dir DIR   override the snapshot's model_dir (checkpoints
+                    moved/copied since the run)
+  --out-ledger P    append replay_start/replay_verdict events there
+                    (default: <ledger>.replay.jsonl; "" disables)
+  key=value         extra global config overrides applied last
+                    (e.g. dev=cpu)
+
+Exit codes: 0 bit_exact, 2 diverged_at_step, 3 unreproducible, 4 usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _load_live_config(path: str):
+    from cxxnet_tpu.config import parse_config_file
+    return parse_config_file(path)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.split("\n")[0],
+        usage="replay.py <ledger> [options] [key=value ...]")
+    ap.add_argument("ledger", help="run-ledger JSONL")
+    ap.add_argument("--list", action="store_true",
+                    help="list replayable incidents and exit")
+    ap.add_argument("--incident", type=int, default=-1,
+                    help="incident index (default: last)")
+    ap.add_argument("--last", action="store_true",
+                    help="replay the last incident (default)")
+    ap.add_argument("--failpoints", choices=("on", "off"),
+                    default="off",
+                    help="re-arm the recorded failpoints, "
+                         "step-compensated (default off)")
+    ap.add_argument("--steps", type=int, default=0,
+                    help="cap replay at K steps")
+    ap.add_argument("--model-dir", default="",
+                    help="override the snapshot's model_dir")
+    ap.add_argument("--config", default="",
+                    help="live config tree to drift-check against "
+                         "the recorded snapshot")
+    ap.add_argument("--out-ledger", default=None,
+                    help="replay-event ledger (default: "
+                         "<ledger>.replay.jsonl; '' disables)")
+    ap.add_argument("--no-strict", action="store_true",
+                    help="config drift warns instead of failing")
+    ap.add_argument("overrides", nargs="*",
+                    help="extra global key=value config overrides")
+    args = ap.parse_args(argv)
+
+    from cxxnet_tpu.replay import (ReconstructError, execute,
+                                   list_incidents, reconstruct)
+    from cxxnet_tpu.telemetry.ledger import read_ledger
+
+    overrides = []
+    for ov in args.overrides:
+        if "=" not in ov:
+            print("bad override (want key=value): %r" % ov,
+                  file=sys.stderr)
+            return 4
+        k, _, v = ov.partition("=")
+        overrides.append((k.strip(), v.strip()))
+
+    if args.list:
+        events = read_ledger(args.ledger)
+        rows = list_incidents(events)
+        if not rows:
+            print("no replayable incidents in %s" % args.ledger)
+            return 0
+        for i, e in enumerate(rows):
+            bits = [f"[{i}]", str(e.get("event"))]
+            for k in ("round", "step", "reason", "provenance"):
+                if e.get(k) not in (None, ""):
+                    bits.append(f"{k}={e[k]}")
+            print(" ".join(bits))
+        return 0
+
+    live_cfg = _load_live_config(args.config) if args.config else None
+    incident = None if args.incident < 0 else args.incident
+    try:
+        plan = reconstruct(args.ledger, incident=incident,
+                           model_dir=args.model_dir,
+                           live_config=live_cfg,
+                           strict=not args.no_strict)
+    except ReconstructError as e:
+        print("replay: verdict: %s" % e, file=sys.stderr)
+        return 3
+    out_ledger = args.out_ledger
+    if out_ledger is None:
+        out_ledger = args.ledger + ".replay.jsonl"
+    res = execute(plan, failpoints_on=(args.failpoints == "on"),
+                  max_steps=args.steps, out_ledger=out_ledger,
+                  overrides=overrides)
+    print(res.report(plan))
+    if res.verdict == "bit_exact":
+        return 0
+    return 3 if res.verdict.startswith("unreproducible") else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

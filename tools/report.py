@@ -144,8 +144,20 @@ def section_rounds(events: List[Dict], out: List[str]) -> None:
 _INCIDENT_EVENTS = ("sentinel_trip", "rollback", "breaker_transition",
                     "hang_dump", "straggler", "recompile_storm")
 
+# events tools/replay.py can time-travel back into; the --incident N
+# address is the row's index among THESE events in file order (must
+# match cxxnet_tpu.replay.reconstruct.list_incidents)
+try:
+    from cxxnet_tpu.replay.reconstruct import \
+        INCIDENT_EVENTS as _REPLAYABLE_EVENTS
+except Exception:                                # report must render
+    _REPLAYABLE_EVENTS = ("sentinel_trip", "rollback",
+                          "deploy_incident", "dataservice_degrade",
+                          "straggler")
 
-def section_incidents(events: List[Dict], out: List[str]) -> None:
+
+def section_incidents(events: List[Dict], out: List[str],
+                      ledger_path: str = "") -> None:
     counts = Counter(e.get("event") for e in events)
     out.append("## Event summary")
     out.append("")
@@ -174,6 +186,10 @@ def section_incidents(events: List[Dict], out: List[str]) -> None:
         return
     out.append("## Incident timeline")
     out.append("")
+    # --incident N addressing for the replay hint under each row
+    replay_idx = {id(e): i for i, e in enumerate(
+        e2 for e2 in events
+        if e2.get("event") in _REPLAYABLE_EVENTS)}
     for e in incidents[:100]:
         etype = e.get("event")
         host = e.get("host", 0)
@@ -213,6 +229,10 @@ def section_incidents(events: List[Dict], out: List[str]) -> None:
         if e.get("trace_id"):
             line += " — trace `%s`" % e["trace_id"]
         out.append(line)
+        if id(e) in replay_idx:
+            out.append("  - replay with: `python tools/replay.py %s "
+                       "--incident %d`" % (ledger_path or "<ledger>",
+                                           replay_idx[id(e)]))
         if etype == "hang_dump" and e.get("stacks"):
             first = str(e["stacks"]).strip().splitlines()
             out.append("")
@@ -662,7 +682,7 @@ def generate(ledger_path: str, telemetry_log: Optional[str],
     out: List[str] = []
     section_identity(events, out)
     section_rounds(events, out)
-    section_incidents(events, out)
+    section_incidents(events, out, ledger_path=ledger_path or "")
     section_modelhealth(events, out)
     section_serving(events, out)
     section_deployments(events, out)
