@@ -37,7 +37,8 @@ from .core import (Finding, LintPass, Project, build_parents,
 #: declarations, this is only the prefix filter)
 NAMESPACE_PREFIXES = ("serve_", "telemetry_", "elastic_", "io_retry_",
                       "fsdp_", "shard_ckpt", "compile_cache",
-                      "data_service", "health_", "deploy_", "replay_")
+                      "data_service", "health_", "deploy_", "replay_",
+                      "lm_serve", "kv_")
 
 _FN = (ast.FunctionDef, ast.AsyncFunctionDef)
 

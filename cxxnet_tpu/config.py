@@ -362,6 +362,90 @@ def parse_serve_config(cfg: ConfigPairs) -> ServeConfig:
     return sc
 
 
+@dataclasses.dataclass(frozen=True)
+class LMServeConfig:
+    """The ``lm_serve_*`` / ``kv_*`` knob set (doc/tasks.md "LM
+    serving"): paged KV-cache geometry plus the continuous-batching
+    decode scheduler. Same validated-namespace contract as
+    ``serve_*`` — a typo'd key raises instead of silently decoding
+    with defaults."""
+    kv_block_size: int = 16       # kv_block_size: tokens per cache block
+    kv_pool_blocks: int = 64      # kv_pool_blocks: blocks in the pool
+    kv_dtype: str = ""            # kv_dtype: cache dtype ('' = compute)
+    max_seqs: int = 4             # lm_serve_max_seqs: decode batch rows
+    max_context: int = 128        # lm_serve_max_context: prompt+gen cap
+    max_new_tokens: int = 32      # lm_serve_max_new_tokens: default cap
+    prefill_chunk: int = 16       # lm_serve_prefill_chunk: tokens/step
+    max_queue: int = 32           # lm_serve_max_queue: waiting requests
+    eos: int = -1                 # lm_serve_eos: stop token (-1 = none)
+    role: str = "both"            # lm_serve_role: both|prefill|decode
+    handoff_port: int = 0         # lm_serve_handoff_port (0 = ephemeral)
+    deadline_ms: float = 0.0      # lm_serve_deadline_ms (0 = none)
+
+    @property
+    def max_blocks_per_seq(self) -> int:
+        """Block-table width: blocks needed to hold ``max_context``
+        tokens (every compiled shape uses this fixed T)."""
+        return -(-self.max_context // self.kv_block_size)
+
+
+def parse_lm_serve_config(cfg: ConfigPairs) -> LMServeConfig:
+    """Collect/validate the ``lm_serve_*`` / ``kv_*`` keys (last
+    occurrence wins; unknown keys in either namespace fail fast)."""
+    known = {
+        "kv_block_size": ("kv_block_size", int),
+        "kv_pool_blocks": ("kv_pool_blocks", int),
+        "kv_dtype": ("kv_dtype", str),
+        "lm_serve_max_seqs": ("max_seqs", int),
+        "lm_serve_max_context": ("max_context", int),
+        "lm_serve_max_new_tokens": ("max_new_tokens", int),
+        "lm_serve_prefill_chunk": ("prefill_chunk", int),
+        "lm_serve_max_queue": ("max_queue", int),
+        "lm_serve_eos": ("eos", int),
+        "lm_serve_role": ("role", str),
+        "lm_serve_handoff_port": ("handoff_port", int),
+        "lm_serve_deadline_ms": ("deadline_ms", float),
+    }
+    vals = {}
+    for name, val in cfg:
+        if name.startswith("lm_serve_") or name.startswith("kv_"):
+            if name not in known:
+                raise ConfigError(
+                    f"unknown lm-serve setting {name!r}; valid keys: "
+                    + ", ".join(sorted(known)))
+            field, conv = known[name]
+            try:
+                vals[field] = conv(val)
+            except ValueError as e:
+                raise ConfigError(f"bad {name} value {val!r}: {e}")
+    lc = LMServeConfig(**vals)
+    if lc.kv_block_size < 1 or lc.kv_pool_blocks < 2:
+        raise ConfigError(
+            "kv_block_size must be >= 1 and kv_pool_blocks >= 2 "
+            "(block 0 is reserved scratch), got "
+            f"{lc.kv_block_size}/{lc.kv_pool_blocks}")
+    if lc.max_seqs < 1 or lc.max_queue < 1:
+        raise ConfigError(
+            "lm_serve_max_seqs and lm_serve_max_queue must be >= 1, "
+            f"got {lc.max_seqs}/{lc.max_queue}")
+    if lc.max_context < 1 or lc.max_new_tokens < 1:
+        raise ConfigError(
+            "lm_serve_max_context and lm_serve_max_new_tokens must be "
+            f">= 1, got {lc.max_context}/{lc.max_new_tokens}")
+    if lc.prefill_chunk < 1 or lc.prefill_chunk % lc.kv_block_size:
+        raise ConfigError(
+            f"lm_serve_prefill_chunk ({lc.prefill_chunk}) must be a "
+            f"positive multiple of kv_block_size ({lc.kv_block_size}) "
+            "so chunk boundaries align with cache blocks")
+    if lc.role not in ("both", "prefill", "decode"):
+        raise ConfigError(
+            f"lm_serve_role must be both|prefill|decode, got {lc.role!r}")
+    if lc.deadline_ms < 0:
+        raise ConfigError(
+            f"lm_serve_deadline_ms must be >= 0, got {lc.deadline_ms}")
+    return lc
+
+
 # -- sharding -----------------------------------------------------------------
 
 @dataclasses.dataclass(frozen=True)

@@ -64,6 +64,30 @@ def rope(x: jax.Array, theta: float = 10000.0,
     return out.astype(x.dtype)
 
 
+def rope_at(x: jax.Array, theta: float, pos: jax.Array) -> jax.Array:
+    """Rotary embedding at explicit per-token positions: ``x`` is
+    (B, S, H, D), ``pos`` is an int array (B, S) of absolute positions.
+    Element-for-element the same math as :func:`rope` (same ``pos * inv``
+    products, same cos/sin combine), so a decode step that rotates one
+    token at position ``p`` reproduces bit-for-bit what a full forward
+    pass computed for that row — the property the paged KV-cache's
+    greedy-decode parity contract rests on. Needed because ``rope``'s
+    scalar ``offset`` cannot express a batch of sequences each at a
+    different decode position."""
+    B, S, H, D = x.shape
+    if D % 2:
+        raise ValueError(f"rope needs an even head_dim, got {D}")
+    p = pos.astype(jnp.float32)
+    inv = theta ** (-jnp.arange(0, D // 2, dtype=jnp.float32) / (D // 2))
+    ang = p[:, :, None] * inv[None, None, :]          # (B, S, D/2)
+    cos = jnp.cos(ang)[:, :, None, :]
+    sin = jnp.sin(ang)[:, :, None, :]
+    x1, x2 = x[..., : D // 2], x[..., D // 2:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                          axis=-1)
+    return out.astype(x.dtype)
+
+
 def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
                         causal: bool = False,
                         scale: Optional[float] = None) -> jax.Array:
@@ -76,6 +100,49 @@ def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
         s = jnp.where(qi >= ki, s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(p.dtype)).astype(q.dtype)
+
+
+def paged_attention(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
+                    tables: jax.Array, q_pos: jax.Array,
+                    lengths: jax.Array,
+                    scale: Optional[float] = None) -> jax.Array:
+    """Attention over a paged KV-cache (vLLM's PagedAttention shape,
+    gather-style): each sequence's keys/values live in fixed-size token
+    blocks of a shared pool, addressed by a per-sequence block table.
+
+    q:        (B, Q, H, D) query tokens (Q=1 for a decode step, Q=chunk
+              for prefill);
+    k_pool /
+    v_pool:   (N, bs, H, D) — N blocks of bs tokens each (block 0 is the
+              caller's scratch block: padding rows write there and the
+              masks below never read it as valid);
+    tables:   (B, T) int32 — block ids; logical token ``i`` of sequence
+              ``b`` lives at ``(tables[b, i // bs], i % bs)``;
+    q_pos:    (B, Q) int32 absolute positions of the query tokens;
+    lengths:  (B,) int32 valid tokens per sequence (0 = dead row).
+
+    Masking is causal-by-position AND bounded by ``lengths`` (block-tail
+    padding), mirroring ``attention_reference``'s -1e30 + softmax
+    convention; logits accumulate in fp32 (preferred_element_type), so
+    the output matches the reference path to fp32 tolerance. Per-row
+    math depends only on that row's q/table/pool content — co-batched
+    sequences cannot perturb each other, which is what makes
+    iteration-level (continuous) batching bit-identical to the
+    request-level path. Returns (B, Q, H, D)."""
+    N, bs, H, D = k_pool.shape
+    B, T = tables.shape
+    kg = k_pool[tables].reshape(B, T * bs, H, D)
+    vg = v_pool[tables].reshape(B, T * bs, H, D)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, kg,
+                   preferred_element_type=jnp.float32) * _scale(q, scale)
+    # gathered flat index IS the logical token position (ordered tables)
+    k_pos = lax.broadcasted_iota(jnp.int32, (B, 1, 1, T * bs), 3)
+    mask = (k_pos <= q_pos[:, None, :, None]) \
+        & (k_pos < lengths[:, None, None, None])
+    s = jnp.where(mask, s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p,
+                      vg.astype(p.dtype)).astype(q.dtype)
 
 
 def gather_kv_attention(q: jax.Array, k: jax.Array, v: jax.Array,

@@ -89,6 +89,12 @@ class MicroBatcher:
         self._rows_lock = threading.Lock()
         self._queued_rows = 0
         self._dispatching = 0           # flushes currently past _release
+        # external live-work probes (serve/lm scheduler): callables
+        # returning a count of in-flight items OUTSIDE the row
+        # accounting — decode sequences still holding KV blocks. `idle`
+        # consults them so hot reload / deploy gating never swaps
+        # weights under a half-generated stream.
+        self._live_probes: List = []
         # admitted-but-undispatched rows, straight off the backpressure
         # accounting (labeled like the ServingStats serve metrics;
         # close() drops the series again)
@@ -166,15 +172,31 @@ class MicroBatcher:
         with self._rows_lock:
             return self._queued_rows
 
+    def add_idle_probe(self, probe) -> None:
+        """Register a live-work probe (a callable returning an int count
+        of in-flight items) that must read 0 before ``idle`` is True.
+        The LM scheduler registers its live-sequence count here: a
+        drained micro-batcher with decodes still holding KV blocks is
+        NOT idle — reload/deploy gating reads ``idle`` to decide when a
+        weight swap is safe, and swapping mid-stream would hand a
+        sequence logits from a model that never saw its prefix."""
+        with self._rows_lock:
+            self._live_probes.append(probe)
+
     @property
     def idle(self) -> bool:
-        """True when nothing is admitted AND no flush is mid-dispatch —
-        the quiesce condition a hot weight reload drains to. queued_rows
-        alone is not enough: _flush releases the row accounting BEFORE
-        the device call, so a reload keyed on it could swap weights under
-        an in-flight dispatch."""
+        """True when nothing is admitted AND no flush is mid-dispatch
+        AND every registered live-work probe reads 0 — the quiesce
+        condition a hot weight reload drains to. queued_rows alone is
+        not enough: _flush releases the row accounting BEFORE the
+        device call, so a reload keyed on it could swap weights under
+        an in-flight dispatch; and LM decode sequences hold KV state
+        across many device calls with zero queued rows in between."""
         with self._rows_lock:
-            return self._queued_rows == 0 and self._dispatching == 0
+            probes = list(self._live_probes)
+            if self._queued_rows != 0 or self._dispatching != 0:
+                return False
+        return all(int(p()) == 0 for p in probes)
 
     # -- worker side -----------------------------------------------------
     def _release(self, reqs: List[_Request]) -> None:

@@ -95,6 +95,9 @@ class Replica:
         self.slo = slo
         self.degraded_queue_frac = float(degraded_queue_frac)
         self.slo_burn_degraded = float(slo_burn_degraded)
+        # LM serving plane (serve/lm LMScheduler), attached by
+        # ReplicaPool.attach_lm; None on predict-only fleets
+        self.lm = None
         self._state = UP
         # serializes request admission against lifecycle transitions:
         # a submit holds it across the state/version re-check AND the
@@ -208,6 +211,11 @@ class Replica:
 
     def close(self, drain: bool = True) -> None:
         self.set_state(DOWN)
+        # LM plane first: its scheduler holds KV blocks the batcher's
+        # idle probe watches, so draining it unblocks the batcher drain
+        if self.lm is not None:
+            self.lm.stop(drain=drain)
+            self.lm.engine.close()
         self.batcher.close(drain=drain)
         self.engine.stats.unregister()
         fam = REGISTRY.get("cxxnet_serve_replica_state")
@@ -443,6 +451,72 @@ class ReplicaPool:
                                    "ok" if ok else "failed").inc()
         fut.add_done_callback(_done)
         return fut
+
+    # -- LM serving plane (serve/lm) --------------------------------------
+    def attach_lm(self, lm_cfg) -> None:
+        """Bring up the LM serving plane: one paged-KV LMEngine +
+        continuous-batching scheduler per replica, sharing the
+        replica's weights / mesh / hot-reload machinery. The scheduler
+        registers as a batcher idle probe (a drain waits for decode
+        sequences still holding KV blocks, not just batch rows) and as
+        the stats ``lm`` hook (/statz shows rows + KV occupancy)."""
+        from .lm import LMEngine, LMScheduler
+        for rep in self.replicas:
+            if rep.lm is not None:
+                raise RuntimeError(
+                    f"replica {rep.idx} already has an LM plane")
+            lme = LMEngine(rep.engine, lm_cfg)
+            sched = LMScheduler(lme, lm_cfg)
+            sched.start()
+            rep.batcher.add_idle_probe(sched.live_count)
+            rep.engine.stats.lm = sched.snapshot
+            rep.lm = sched
+
+    def set_lm_role(self, idx: int, role: str, peer=None) -> None:
+        """Flip one replica's plane mid-run — e.g. disaggregate by
+        pointing replica 0 at replica 1's handoff listener:
+        ``pool.set_lm_role(0, "prefill",
+        peer=pool.replicas[1].lm.handoff_addr)``."""
+        rep = self.replicas[int(idx)]
+        if rep.lm is None:
+            raise RuntimeError(f"replica {idx} has no LM plane")
+        rep.lm.set_role(role, peer)
+
+    def submit_lm(self, prompt, max_new: Optional[int] = None,
+                  deadline_ms: Optional[float] = None,
+                  version: Optional[str] = None):
+        """Route one generation request; returns its StreamHandle.
+        Same pick discipline as :meth:`submit` (availability, version
+        pin, admission re-check under the replica lock) over the
+        replicas that can START a sequence — decode-role replicas only
+        take prefill handoffs, so the router skips them."""
+        cands = [r for r in self.replicas
+                 if r.lm is not None and r.lm.role != "decode"
+                 and (version is None or r.version == version)]
+        if version is not None and not cands:
+            raise UnknownVersion(
+                f"no replica serves model version {version!r}; "
+                f"available: {sorted(self.versions())}")
+        if not cands:
+            raise NoHealthyReplica(
+                "no replica accepts LM requests (none attached, or all "
+                "decode-role)")
+        for _ in range(8):            # re-pick bound, as in submit()
+            avail = [r for r in cands if r.available()]
+            if not avail:
+                raise NoHealthyReplica(
+                    "no LM replica available: all down, draining, or "
+                    "breaker-open — retry later")
+            rep = min(avail, key=lambda r: r.lm.live_count())
+            with rep.admission_lock:
+                if rep.state != UP or (version is not None
+                                       and rep.version != version):
+                    continue          # lost a race with a reload
+                return rep.lm.submit(prompt, max_new=max_new,
+                                     deadline_ms=deadline_ms)
+        raise NoHealthyReplica(
+            "could not admit LM request: replicas kept transitioning "
+            "(reload storm?) — retry later")
 
     def failed_traces(self, version: str) -> List[str]:
         """Trace ids of recent failed requests against ``version``

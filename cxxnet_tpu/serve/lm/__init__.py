@@ -1,0 +1,22 @@
+"""Autoregressive LM serving: paged KV-cache, continuous batching,
+token streaming, prefill/decode disaggregation.
+
+Layering (each module only reaches down):
+
+* ``blocks``    — host-side block allocator + occupancy gauges
+* ``engine``    — LMEngine: paged attention step cells over a wrapped
+                  InferenceEngine (weights / mesh / hot-reload shared)
+* ``stream``    — ndjson event + HTTP chunked framing helpers
+* ``handoff``   — prefill->decode KV shipping (data_service wire)
+* ``scheduler`` — continuous-batching loop, StreamHandle, roles
+
+Entry points: ``ReplicaPool.attach_lm`` wires one LMScheduler per
+replica; ``ServeServer`` exposes ``POST /generate`` (streaming).
+"""
+
+from .blocks import BlockPool, PoolExhausted, SCRATCH_BLOCK
+from .engine import LMEngine
+from .scheduler import LMScheduler, StreamHandle
+
+__all__ = ["BlockPool", "PoolExhausted", "SCRATCH_BLOCK", "LMEngine",
+           "LMScheduler", "StreamHandle"]

@@ -1,0 +1,466 @@
+"""LMEngine: autoregressive decoding over a paged KV-cache.
+
+Wraps an existing :class:`serve.engine.InferenceEngine` (weights,
+mesh, hot-reload machinery stay THEIRS — a fleet weight swap lands
+here for free through the shared ``_weights_lock`` pair-read) and adds
+the LM execution model the whole-request engine cannot express:
+
+* **paged KV-cache** — one preallocated ``(num_blocks, block_size,
+  heads, head_dim)`` pool per attention layer, sharded over the
+  replica's device slice by ``parallel/rules.py`` partition specs
+  (``kv_cache_rules`` — heads over the mesh 'model' axis, the SAME
+  placement the mha q/k/v projections declare). Per-sequence block
+  tables are fixed-width ``(T,)`` host arrays padded with the scratch
+  block 0; attention over the cache is ``ops.attention.paged_attention``
+  (gather by table, mask by length).
+
+* **one traced step function** for prefill AND decode: prefill runs it
+  at ``(B=1, C=prefill_chunk)``, decode at ``(B=max_seqs, C=1)`` — two
+  compiled cells total, LRU'd with hit/miss counters like the
+  whole-request engine's bucket cache, so steady-state decode performs
+  ZERO recompiles. Every shape in the cell is static (fixed T, fixed
+  C); varying sequence lengths live entirely in the ``lengths`` mask.
+
+* **bit-parity by construction** — every op in the step is row-
+  independent (einsums batch over rows, layernorm/softmax are
+  per-position), block ids never enter the math (the table gather
+  produces identical values wherever the blocks live), and both the
+  continuous-batching scheduler and :meth:`generate_whole` drive the
+  SAME compiled cells with identical per-row inputs — so greedy tokens
+  are bit-identical between the two paths (asserted in
+  tests/test_lm_serve.py).
+
+The graph is interpreted layer-by-layer: embed / posembed / mha get
+position-aware custom paths (``rope_at``, cache scatter, paged
+attention); layernorm / ffn / seqfc / add reuse ``layer.apply``
+verbatim — same weights, same math, same dtypes as training.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...config import LMServeConfig, parse_policy
+from ...telemetry.registry import REGISTRY
+from ..engine import InferenceEngine
+from .blocks import SCRATCH_BLOCK, BlockPool
+
+#: layer types the LM interpreter understands; anything else in the
+#: graph is a loud build-time error, not a silent wrong answer
+SUPPORTED_TYPES = frozenset({"embed", "layernorm", "posembed", "mha",
+                             "ffn", "seqfc", "add", "lmloss"})
+
+
+class LMEngine:
+    """Paged-KV autoregressive engine over a wrapped InferenceEngine."""
+
+    def __init__(self, engine: InferenceEngine, cfg: LMServeConfig):
+        import jax.numpy as jnp
+        self.engine = engine
+        self.cfg = cfg
+        tr = engine.trainer
+        self.trainer = tr
+        c, y, s = tr.graph.input_shape
+        if c != 1 or y != 1:
+            raise ValueError(
+                "lm serve needs a flat (1,1,S) token-id input node, got "
+                f"input_shape {tr.graph.input_shape}")
+        self.block_size = cfg.kv_block_size
+        self.num_blocks = cfg.kv_pool_blocks
+        self.max_seqs = cfg.max_seqs
+        self.max_context = cfg.max_context
+        self.chunk = cfg.prefill_chunk
+        #: fixed block-table width — EVERY compiled shape uses this T;
+        #: a varying T would change the attention reduction bracketing
+        #: and break bit-parity between paths
+        self.T = cfg.max_blocks_per_seq
+        self.compute_dtype = engine.compute_dtype
+        self.kv_dtype = (parse_policy(cfg.kv_dtype).compute_dtype
+                         if cfg.kv_dtype else self.compute_dtype)
+        self.vocab = 0
+        self._mha: List[Tuple[int, object]] = []   # (layer idx, layer)
+        self._validate_graph()
+        self.block_pool = BlockPool(self.num_blocks, self.block_size,
+                                    instance=engine.stats.instance)
+        # device pools: {mha name: {"k"/"v": (N, bs, H, D)}}, placed by
+        # the SAME rule machinery that places training params
+        self.pools = self._init_pools(jnp)
+        self._pool_lock = threading.Lock()
+        # compiled-cell LRU (prefill cell, decode cell, kv-install
+        # cell): mirrors InferenceEngine._compiled, with its own
+        # counter family so the zero-steady-state-recompile contract
+        # is assertable per engine
+        self._cache: "OrderedDict[tuple, object]" = OrderedDict()
+        self._cache_lock = threading.Lock()
+        fam = REGISTRY.counter(
+            "cxxnet_lm_compile_cache_events_total",
+            "LM step compile-cache events", labels=("engine", "event"))
+        self._cc_fam = fam
+        self._c_hit = fam.labels(engine.stats.instance, "hit")
+        self._c_miss = fam.labels(engine.stats.instance, "miss")
+
+    # -- validation / pools ----------------------------------------------
+    def _validate_graph(self) -> None:
+        g = self.trainer.graph
+        net = self.trainer.net
+        seen_loss = False
+        for li, (spec, layer) in enumerate(zip(g.layers, net.layers)):
+            if spec.type not in SUPPORTED_TYPES:
+                raise ValueError(
+                    f"lm serve: unsupported layer type {spec.type!r} "
+                    f"({spec.name!r}); supported: "
+                    + ", ".join(sorted(SUPPORTED_TYPES)))
+            if layer.is_loss:
+                seen_loss = True
+                continue
+            if seen_loss:
+                raise ValueError(
+                    "lm serve: loss layers must come last in the graph")
+            if spec.type == "embed":
+                self.vocab = layer.vocab_size
+            if spec.type == "mha":
+                if not layer.causal:
+                    raise ValueError(
+                        f"lm serve: mha {spec.name!r} must be causal "
+                        "(causal = 1) for autoregressive decoding")
+                if spec.is_shared:
+                    raise ValueError(
+                        "lm serve: weight-tied (shared) mha layers are "
+                        "not supported — each graph position needs its "
+                        "own KV pool")
+                self._mha.append((li, layer))
+            if spec.type == "posembed":
+                e, s, _ = net.node_shapes[spec.nindex_in[0]]
+                if s < self.max_context:
+                    raise ValueError(
+                        f"lm serve: posembed table covers {s} positions "
+                        f"< lm_serve_max_context {self.max_context}")
+        if not self._mha:
+            raise ValueError("lm serve: graph has no mha layer")
+        if self.vocab <= 0:
+            raise ValueError("lm serve: graph has no embed layer")
+        c, y, s = g.input_shape
+        if s < self.chunk:
+            # the prefill cell runs the graph at S = chunk; a posembed
+            # sized to the training S would be the only S-sensitive
+            # piece and is validated above — nothing else reads S
+            pass
+
+    def _init_pools(self, jnp):
+        from jax.sharding import PartitionSpec as P
+        from ...parallel.rules import kv_cache_rules, match_partition_rules
+        net = self.trainer.net
+        mesh = self.trainer.mesh
+        shapes = {}
+        for li, layer in self._mha:
+            e = net.node_shapes[net.graph.layers[li].nindex_in[0]][0]
+            h, d = layer.nhead, e // layer.nhead
+            shape = (self.num_blocks, self.block_size, h, d)
+            shapes[net.graph.layers[li].name] = {
+                "k": np.zeros(shape, self.kv_dtype),
+                "v": np.zeros(shape, self.kv_dtype)}
+        specs = match_partition_rules(kv_cache_rules(), shapes)
+        if mesh.model_parallel <= 1:
+            specs = {n: {"k": P(), "v": P()} for n in shapes}
+        return mesh.shard_params(shapes, specs)
+
+    # -- the traced step -------------------------------------------------
+    def _kv_write(self, pool, kv, tables, positions, lengths, jnp):
+        """Scatter this step's keys/values into the pool. ``positions``
+        at/after a row's ``lengths`` (chunk padding, dead rows) are
+        redirected into the scratch block 0, which the attention mask
+        never reads — so one fixed-shape scatter covers every case."""
+        B, C = positions.shape
+        valid = (positions >= 0) & (positions < lengths[:, None])
+        blk = jnp.clip(positions // self.block_size, 0, self.T - 1)
+        blocks = jnp.take_along_axis(tables, blk, axis=1)
+        blocks = jnp.where(valid, blocks, SCRATCH_BLOCK)
+        slots = jnp.where(valid, positions % self.block_size, 0)
+        return pool.at[blocks, slots].set(kv.astype(pool.dtype))
+
+    def _mha_step(self, layer, lparams, x, k_pool, v_pool, tables,
+                  positions, lengths, cdt, jnp):
+        """The mha layer's decode-path apply: identical projection /
+        rope / output math to layers/seq.py, with attention over the
+        paged cache instead of the in-activation k/v."""
+        from ...ops.attention import paged_attention, rope_at
+        B, C = positions.shape
+        xs = x.reshape(B, C, x.shape[-1]).astype(cdt)
+
+        def proj(nm):
+            w = lparams[nm]["wmat"].astype(cdt)
+            out = jnp.einsum("bse,ehd->bshd", xs, w)
+            if "bias" in lparams[nm]:
+                out = out + lparams[nm]["bias"].astype(cdt)
+            return out
+
+        q, k, v = proj("q"), proj("k"), proj("v")
+        if layer.rope:
+            pos = jnp.maximum(positions, 0)
+            q = rope_at(q, layer.rope_theta, pos)
+            k = rope_at(k, layer.rope_theta, pos)
+        k_pool = self._kv_write(k_pool, k, tables, positions, lengths, jnp)
+        v_pool = self._kv_write(v_pool, v, tables, positions, lengths, jnp)
+        o = paged_attention(q.astype(k_pool.dtype), k_pool, v_pool,
+                            tables, positions, lengths)
+        wo = lparams["o"]["wmat"].astype(cdt)
+        y = jnp.einsum("bshd,hde->bse", o.astype(cdt), wo)
+        if "bias" in lparams["o"]:
+            y = y + lparams["o"]["bias"].astype(cdt)
+        return y.reshape(B, C, 1, y.shape[-1]), k_pool, v_pool
+
+    def _make_step(self):
+        """Build the (un-jitted) step function. ONE definition serves
+        prefill and decode; the jit cache keys it by (B, C)."""
+        import jax
+        import jax.numpy as jnp
+        from ...layers import ApplyCtx
+        net = self.trainer.net
+        g = net.graph
+        cdt = self.compute_dtype
+        mha_at = {li for li, _ in self._mha}
+        out_node = None
+        for spec, layer in zip(g.layers, net.layers):
+            if not layer.is_loss:
+                out_node = spec.nindex_out[0]
+
+        def step(params, state, pools, ids, positions, tables, lengths,
+                 last_idx):
+            B, C = ids.shape
+            nodes: List = [None] * g.num_nodes
+            new_pools = dict(pools)
+            for li, (spec, layer) in enumerate(zip(g.layers, net.layers)):
+                if layer.is_loss:
+                    continue
+                if spec.type == "embed":
+                    w = params[layer.name]["wmat"].astype(cdt)
+                    out = jnp.take(w, jnp.maximum(ids, 0), axis=0)
+                    nodes[spec.nindex_out[0]] = out.reshape(B, C, 1, -1)
+                elif spec.type == "posembed":
+                    pe = params[layer.name]["wmat"].astype(cdt)
+                    p = jnp.clip(positions, 0, pe.shape[0] - 1)
+                    add = jnp.take(pe, p, axis=0)
+                    nodes[spec.nindex_out[0]] = (
+                        nodes[spec.nindex_in[0]]
+                        + add.reshape(B, C, 1, -1))
+                elif li in mha_at:
+                    name = spec.name
+                    y, nk, nv = self._mha_step(
+                        layer, params[name],
+                        nodes[spec.nindex_in[0]],
+                        new_pools[name]["k"], new_pools[name]["v"],
+                        tables, positions, lengths, cdt, jnp)
+                    new_pools[name] = {"k": nk, "v": nv}
+                    nodes[spec.nindex_out[0]] = y
+                else:
+                    ctx = ApplyCtx(train=False,
+                                   rng=jax.random.PRNGKey(0),
+                                   compute_dtype=cdt)
+                    inputs = [nodes[ni] for ni in spec.nindex_in]
+                    outs, _ = layer.apply(params.get(layer.name, {}),
+                                          state.get(layer.name, {}),
+                                          inputs, ctx)
+                    for ni, o in zip(spec.nindex_out, outs):
+                        nodes[ni] = o
+            logits = nodes[out_node].reshape(B, C, -1).astype(jnp.float32)
+            last = logits[jnp.arange(B), last_idx]          # (B, V)
+            token = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            return token, last, new_pools
+
+        return step
+
+    def _compiled(self, key):
+        """LRU lookup of a compiled cell (('step', B, C) or
+        ('install',)); a miss builds + counts — the smoke asserts the
+        miss counter FREEZES after warmup (zero steady-state
+        recompiles)."""
+        with self._cache_lock:
+            fn = self._cache.get(key)
+            if fn is not None:
+                self._cache.move_to_end(key)
+                self._c_hit.inc()
+                return fn
+            import jax
+            if key[0] == "step":
+                fn = jax.jit(self._make_step())
+            else:
+                fn = jax.jit(self._make_install())
+            self._cache[key] = fn
+            self._c_miss.inc()
+            return fn
+
+    def compile_info(self) -> Dict[str, int]:
+        with self._cache_lock:
+            return {"size": len(self._cache),
+                    "hits": int(self._c_hit.value),
+                    "misses": int(self._c_miss.value)}
+
+    def _weights(self):
+        """(params, net_state) pair-read under the wrapped engine's
+        weights lock — a hot reload can never interleave."""
+        tr = self.trainer
+        with self.engine._weights_lock:
+            return tr.params, tr.net_state
+
+    # -- public step API (scheduler + whole-request path) ----------------
+    def run_prefill(self, table: np.ndarray, ids: np.ndarray, p0: int,
+                    n_real: int) -> int:
+        """One prefill chunk for ONE sequence: write KV for tokens at
+        positions ``p0 .. p0+n_real-1``, return the greedy token after
+        the chunk's last real position (meaningful only for the
+        prompt's final chunk). ``ids`` is the fixed-width chunk (C,)
+        with padding beyond ``n_real``."""
+        C = int(ids.shape[0])
+        fn = self._compiled(("step", 1, C))
+        positions = (p0 + np.arange(C, dtype=np.int32))[None, :]
+        lengths = np.asarray([p0 + n_real], np.int32)
+        last_idx = np.asarray([n_real - 1], np.int32)
+        params, state = self._weights()
+        with self._pool_lock:
+            token, _last, new_pools = fn(
+                params, state, self.pools, ids[None, :].astype(np.int32),
+                positions, table[None, :].astype(np.int32), lengths,
+                last_idx)
+            self.pools = new_pools
+            return int(np.asarray(token)[0])
+
+    def run_decode(self, ids: np.ndarray, positions: np.ndarray,
+                   tables: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+        """One continuous-batching decode step over the fixed
+        ``max_seqs`` rows (C = 1). Dead rows carry ``lengths = 0`` and
+        all-scratch tables; their outputs are garbage by contract and
+        the scheduler never reads them. Returns greedy tokens (B,)."""
+        B = self.max_seqs
+        fn = self._compiled(("step", B, 1))
+        params, state = self._weights()
+        with self._pool_lock:
+            token, _last, new_pools = fn(
+                params, state, self.pools,
+                ids.reshape(B, 1).astype(np.int32),
+                positions.reshape(B, 1).astype(np.int32),
+                tables.astype(np.int32), lengths.astype(np.int32),
+                np.zeros((B,), np.int32))
+            self.pools = new_pools
+            return np.asarray(token)
+
+    # -- whole-request reference path ------------------------------------
+    def generate_whole(self, prompt, max_new: Optional[int] = None
+                       ) -> List[int]:
+        """Request-level greedy decode through the SAME compiled cells
+        the continuous scheduler uses (prefill chunks, then the B-row
+        decode cell with only row 0 live) — the bit-parity reference
+        the digest test compares against, and a synchronous generate
+        for tools. Allocates from the shared block pool and frees on
+        exit."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        max_new = int(max_new or self.cfg.max_new_tokens)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if prompt.size + 1 > self.max_context:
+            raise ValueError(
+                f"prompt length {prompt.size} leaves no room to "
+                f"generate within lm_serve_max_context {self.max_context}")
+        pool = self.block_pool
+        table = np.zeros((self.T,), np.int32)
+        blocks: List[int] = []
+
+        def ensure(n_tokens):
+            need = pool.blocks_for_tokens(n_tokens)
+            while len(blocks) < need:
+                got = pool.alloc(1, seq_id=-1)
+                table[len(blocks)] = got[0]
+                blocks.extend(got)
+
+        try:
+            token = None
+            p0 = 0
+            while p0 < prompt.size:
+                c = min(self.chunk, prompt.size - p0)
+                ids = np.zeros((self.chunk,), np.int32)
+                ids[:c] = prompt[p0:p0 + c]
+                ensure(p0 + c)
+                token = self.run_prefill(table, ids, p0, c)
+                p0 += c
+            generated = [token]
+            L = prompt.size
+            eos = self.cfg.eos
+            while (len(generated) < max_new and L < self.max_context
+                   and not (eos >= 0 and generated[-1] == eos)):
+                ensure(L + 1)
+                B = self.max_seqs
+                ids = np.zeros((B,), np.int32)
+                positions = np.zeros((B,), np.int32)
+                tables = np.zeros((B, self.T), np.int32)
+                lengths = np.zeros((B,), np.int32)
+                ids[0] = generated[-1]
+                positions[0] = L
+                tables[0] = table
+                lengths[0] = L + 1
+                toks = self.run_decode(ids, positions, tables, lengths)
+                generated.append(int(toks[0]))
+                L += 1
+            return generated
+        finally:
+            if blocks:
+                pool.free(blocks)
+
+    # -- KV extraction / injection (prefill/decode disaggregation) -------
+    def extract_kv(self, table: np.ndarray) -> Dict[str, Dict[str, np.ndarray]]:
+        """Host copy of one sequence's cache blocks, gathered by its
+        table — full fixed ``(T, bs, H, D)`` shape (padding blocks are
+        scratch content the receiving mask never reads), so the
+        install cell compiles exactly once."""
+        idx = np.asarray(table, np.int32)
+        with self._pool_lock:
+            return {name: {kv: np.asarray(p[kv][idx])
+                           for kv in ("k", "v")}
+                    for name, p in self.pools.items()}
+
+    def _make_install(self):
+        def install(pools, table, kv):
+            out = dict(pools)
+            for name, ent in kv.items():
+                out[name] = {
+                    "k": pools[name]["k"].at[table].set(
+                        ent["k"].astype(pools[name]["k"].dtype)),
+                    "v": pools[name]["v"].at[table].set(
+                        ent["v"].astype(pools[name]["v"].dtype))}
+            return out
+        return install
+
+    def install_kv(self, table: np.ndarray,
+                   kv: Dict[str, Dict[str, np.ndarray]]) -> None:
+        """Scatter a shipped sequence's KV state into this engine's
+        pools at the receiver's own block table (one compiled cell,
+        fixed shape — handoffs don't recompile either)."""
+        if set(kv) != set(self.pools):
+            raise ValueError(
+                f"kv handoff layers {sorted(kv)} != engine layers "
+                f"{sorted(self.pools)}")
+        fn = self._compiled(("install",))
+        with self._pool_lock:
+            self.pools = fn(self.pools, np.asarray(table, np.int32), kv)
+
+    # -- defrag ----------------------------------------------------------
+    def defrag(self) -> Dict[int, int]:
+        """Compact allocated blocks to the front of the pool: gather
+        every pool array through the allocator's permutation and return
+        the old->new id remap the caller applies to its block tables.
+        Runs under the pool lock — no step is in flight while blocks
+        move, so the gather + table rewrite is atomic."""
+        import jax.numpy as jnp
+        with self._pool_lock:
+            old_of_new, remap = self.block_pool.defrag_plan()
+            perm = jnp.asarray(old_of_new)
+            self.pools = {name: {"k": p["k"][perm], "v": p["v"][perm]}
+                          for name, p in self.pools.items()}
+            return remap
+
+    def close(self) -> None:
+        self.block_pool.unregister()
+        self._cc_fam.remove_labels(self.engine.stats.instance, "hit")
+        self._cc_fam.remove_labels(self.engine.stats.instance, "miss")

@@ -8,6 +8,16 @@ dependencies (the same reason the IO pipeline is pure stdlib threading):
   ``{"prob": [[...]]}``
 * ``POST /extract``  ``{"data": ..., "node": "name"}``
   -> ``{"features": [[...]]}``
+* ``POST /generate`` ``{"prompt": [ids...], "max_new": N?,
+  "deadline_ms": T?, "stream": 0|1, "version": "rNNNN"?}`` — LM
+  serving (serve/lm/): streamed by default as ``Transfer-Encoding:
+  chunked`` ndjson, ONE event per chunk flushed as each token lands
+  (see serve/lm/stream.py for the event grammar), so clients measure
+  TTFT / inter-token latency directly; ``stream: 0`` returns
+  ``{"tokens": [...], "reason": "eos"|"length"}`` in one body. A
+  client disconnect mid-stream cancels the sequence and frees its KV
+  blocks. Requires an attached LM plane (``attach_lm`` /
+  ``ReplicaPool.attach_lm``).
 * ``GET  /healthz``  -> ``{"status": "ok"|"degraded"|"open"|"down", ...}``
 * ``GET  /statz``    -> the ServingStats snapshot + breaker/queue state
 
@@ -116,7 +126,7 @@ def _make_handler(server: "ServeServer"):
             return json.loads(self.rfile.read(n).decode("utf-8"))
 
         def do_POST(self):
-            if self.path not in ("/predict", "/extract"):
+            if self.path not in ("/predict", "/extract", "/generate"):
                 self._reply(404, {"error": f"no such path {self.path}"})
                 return
             # full request-lifecycle span (parse -> queue -> infer ->
@@ -137,9 +147,10 @@ def _make_handler(server: "ServeServer"):
         def _handle_post(self):
             try:
                 req = self._read_json()
-                data = np.asarray(req["data"], np.float32)
-                if data.ndim == 1:       # single instance shorthand
-                    data = data[None, :]
+                if self.path != "/generate":  # generate carries token
+                    data = np.asarray(req["data"], np.float32)  # ids,
+                    if data.ndim == 1:        # not a float row matrix
+                        data = data[None, :]
                 timeout_ms = req.get("timeout_ms")
                 # A/B pin: JSON field wins over the header (explicit in
                 # the payload beats ambient routing config)
@@ -147,7 +158,9 @@ def _make_handler(server: "ServeServer"):
                     or self.headers.get("X-Model-Version") or None
                 # hard cap so a wedged worker can't hang handler threads
                 # forever (batcher deadlines are the soft mechanism)
-                if self.path == "/extract":
+                if self.path == "/generate":
+                    self._handle_generate(req, version)
+                elif self.path == "/extract":
                     node = req.get("node", "top")
                     fut = server.submit(data, "extract", node,
                                         timeout_ms=timeout_ms,
@@ -176,6 +189,42 @@ def _make_handler(server: "ServeServer"):
                 self._reply(400, {"error": f"{type(e).__name__}: {e}"})
             except Exception as e:
                 self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+        def _handle_generate(self, req: dict, version) -> None:
+            from .lm.stream import LAST_CHUNK, chunk, encode_event
+            prompt = req.get("prompt")
+            if not isinstance(prompt, (list, tuple)) or not prompt:
+                raise ValueError(
+                    "generate needs a non-empty integer list 'prompt'")
+            handle = server.submit_lm(
+                [int(t) for t in prompt], max_new=req.get("max_new"),
+                deadline_ms=req.get("deadline_ms"), version=version)
+            if not int(req.get("stream", 1)):
+                done = handle.result(timeout=server.result_timeout_s)
+                with TRACER.span("serve.respond", cat="serve"):
+                    self._reply(200, {"tokens": done["tokens"],
+                                      "reason": done["reason"]})
+                return
+            # headers are committed from here on: failures become
+            # in-band error events (already pushed by the scheduler) or
+            # a dropped connection — never a second status line
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            try:
+                with TRACER.span("serve.stream", cat="serve"):
+                    for ev in handle.events(
+                            timeout=server.result_timeout_s):
+                        self.wfile.write(chunk(encode_event(ev)))
+                        self.wfile.flush()     # per-token: TTFT is real
+                    self.wfile.write(LAST_CHUNK)
+                    self.wfile.flush()
+            except (TimeoutError, OSError):
+                # client gone (or stream wedged): release the decode
+                # slot + KV blocks instead of generating into the void
+                handle.cancel()
+                self.close_connection = True
 
     return Handler
 
@@ -236,6 +285,9 @@ class ServeServer:
         self.breaker: Optional[CircuitBreaker] = None
         self.batcher: Optional[MicroBatcher] = None
         self.stats: Optional[ServingStats] = None
+        # single-engine LM plane (serve/lm LMScheduler) — attach_lm();
+        # fleet mode keeps it per replica instead
+        self.lm = None
         if engine is not None:
             self.stats = engine.stats
             if slo_ms > 0:
@@ -293,6 +345,45 @@ class ServeServer:
                 f"available: [{self.engine.weights_version!r}]")
         return self.batcher.submit(data, kind, node,
                                    timeout_ms=timeout_ms)
+
+    # -- LM serving plane -------------------------------------------------
+    def attach_lm(self, lm_cfg) -> "ServeServer":
+        """Bring up the LM plane (parse_lm_serve_config output): per
+        replica in fleet mode, one scheduler on the single engine
+        otherwise. Same idle-probe + stats wiring either way."""
+        if self.pool is not None:
+            self.pool.attach_lm(lm_cfg)
+            return self
+        from .lm import LMEngine, LMScheduler
+        if self.lm is not None:
+            raise RuntimeError("LM plane already attached")
+        lme = LMEngine(self.engine, lm_cfg)
+        sched = LMScheduler(lme, lm_cfg)
+        sched.start()
+        self.batcher.add_idle_probe(sched.live_count)
+        self.stats.lm = sched.snapshot
+        self.lm = sched
+        return self
+
+    def submit_lm(self, prompt, max_new: Optional[int] = None,
+                  deadline_ms: Optional[float] = None,
+                  version: Optional[str] = None):
+        """Route one generation request; returns its StreamHandle."""
+        if self.pool is not None:
+            return self.pool.submit_lm(prompt, max_new=max_new,
+                                       deadline_ms=deadline_ms,
+                                       version=version)
+        if self.lm is None:
+            raise NoHealthyReplica(
+                "no LM plane attached (server.attach_lm / "
+                "ReplicaPool.attach_lm)")
+        if version is not None \
+                and version != self.engine.weights_version:
+            raise UnknownVersion(
+                f"no replica serves model version {version!r}; "
+                f"available: [{self.engine.weights_version!r}]")
+        return self.lm.submit(prompt, max_new=max_new,
+                              deadline_ms=deadline_ms)
 
     # -- health ----------------------------------------------------------
     def health(self) -> Tuple[int, Dict]:
@@ -501,6 +592,12 @@ class ServeServer:
             if self.pool is not None:
                 self.pool.close(drain=True)
             else:
+                # LM plane first: its live sequences hold KV blocks the
+                # batcher's idle probe watches (same order as
+                # Replica.close)
+                if self.lm is not None:
+                    self.lm.stop(drain=True)
+                    self.lm.engine.close()
                 self.batcher.close(drain=True)
             if not self.silent:
                 print(self.log_line(), flush=True)

@@ -105,6 +105,10 @@ class ServingStats:
         # attached by ServeServer when serve_slo_ms is configured;
         # every terminal outcome recorded here feeds it
         self.slo = None
+        # optional LM-serving probe (serve/lm LMScheduler.snapshot),
+        # attached by ReplicaPool.attach_lm; snapshot() inlines it so
+        # /statz shows decode rows / KV occupancy next to batch fill
+        self.lm = None
 
     # -- registry-backed attribute views ---------------------------------
     @property
@@ -316,6 +320,7 @@ class ServingStats:
                 "size": self.cache_size,
                 "capacity": self.cache_capacity,
             },
+            **({"lm": self.lm()} if self.lm is not None else {}),
         }
 
     def log_line(self) -> str:

@@ -82,6 +82,9 @@ KNOWN_EVENTS = (
     # an oversized run_start config snapshot split across lines;
     # replay_start/replay_verdict are the re-execution's own record
     "config_chunk", "replay_start", "replay_verdict",
+    # LM serving (serve/lm/): scheduler start, per-sequence KV-block
+    # eviction (deadline/cancel/pressure), prefill->decode KV handoff
+    "lm_serve_start", "kv_evict", "prefill_handoff",
 )
 
 

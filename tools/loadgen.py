@@ -317,6 +317,227 @@ def run_open(url: str, body: bytes, duration_s: float, qps: float,
     return out
 
 
+# -- LM (token-streaming) load ------------------------------------------------
+#
+# /generate benches measure different latencies than /predict: per-token
+# arrival times off the chunked ndjson stream give time-to-first-token
+# (TTFT: scheduled arrival -> first token event, queueing + prefill) and
+# inter-token latency (decode-step cadence under continuous batching).
+# Percentiles use the SAME nearest-rank rule as everything else here, so
+# SERVE_r*.json numbers stay comparable across request kinds.
+
+def make_lm_payload(prompt_len: int, vocab: int, max_new: int,
+                    version: Optional[str] = None, seed: int = 0) -> bytes:
+    """One pre-encoded /generate body (all requests share it: greedy
+    decode is deterministic, so distinct prompts buy nothing but
+    cache-layout noise)."""
+    import numpy as np
+    ids = np.random.RandomState(seed).randint(1, max(2, vocab),
+                                              size=prompt_len)
+    req: Dict = {"prompt": [int(t) for t in ids], "max_new": int(max_new),
+                 "stream": 1}
+    if version:
+        req["version"] = version
+    return json.dumps(req).encode("utf-8")
+
+
+def _lm_stream_once(conn: http.client.HTTPConnection, body: bytes
+                    ) -> Tuple[bool, str, List[float], bool]:
+    """POST /generate and read the event stream, timestamping each
+    token event as its chunk arrives. Returns (ok, err, token_times,
+    finished) — token_times are perf_counter() stamps in arrival
+    order."""
+    headers = {"Content-Type": "application/json"}
+    dt = _DISTTRACE
+    if dt is not None:
+        tp = dt.current_traceparent()
+        if tp:
+            headers["traceparent"] = tp
+    conn.request("POST", "/generate", body=body, headers=headers)
+    r = conn.getresponse()
+    if r.status != 200:
+        return False, f"HTTP {r.status}: {r.read()[:120]!r}", [], False
+    times: List[float] = []
+    err = ""
+    finished = False
+    while True:
+        line = r.readline()          # one ndjson event per chunk
+        if not line:
+            break
+        line = line.strip()
+        if not line:
+            continue
+        ev = json.loads(line.decode("utf-8"))
+        kind = ev.get("event")
+        if kind == "token":
+            times.append(time.perf_counter())
+        elif kind == "done":
+            finished = True
+            break
+        elif kind == "error":
+            err = f"stream error: {ev.get('reason')}: {ev.get('error')}"
+            break
+    r.read()                          # drain the terminal chunk frame
+    if err:
+        return False, err, times, False
+    if not finished:
+        return False, "stream ended without a done event", times, False
+    return True, "", times, True
+
+
+class _LMCollector:
+    """Per-token accounting sink: TTFT, inter-token gaps, request e2e."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.ttft: List[float] = []
+        self.intertoken: List[float] = []
+        self.e2e: List[float] = []
+        self.tokens = 0
+        self.failures = 0
+        self.errors: List[str] = []
+
+    def ok(self, sched: float, times: List[float], done_t: float) -> None:
+        with self.lock:
+            self.tokens += len(times)
+            if times:
+                self.ttft.append(times[0] - sched)
+                self.intertoken.extend(b - a for a, b
+                                       in zip(times, times[1:]))
+            self.e2e.append(done_t - sched)
+
+    def fail(self, err: str) -> None:
+        with self.lock:
+            self.failures += 1
+            if len(self.errors) < 8:
+                self.errors.append(err)
+
+
+def run_lm_open(url: str, body: bytes, duration_s: float, qps: float,
+                max_workers: int = 64) -> Dict:
+    """Open-loop prompt arrivals against /generate: fixed-rate schedule,
+    TTFT measured from the SCHEDULED arrival (a backed-up prefill queue
+    counts against the server, same philosophy as run_open)."""
+    ep = _Endpoint(url)
+    col = _LMCollector()
+    n = max(1, int(round(duration_s * qps)))
+    interval = 1.0 / qps
+    t0 = time.perf_counter() + 0.05
+    slots: "queue.Queue[Optional[float]]" = queue.Queue()
+
+    def worker():
+        conn = ep.connect()
+        try:
+            while True:
+                sched = slots.get()
+                if sched is None:
+                    return
+                now = time.perf_counter()
+                if now < sched:
+                    time.sleep(sched - now)
+                try:
+                    if _DISTTRACE is not None:
+                        with _DISTTRACE.span("loadgen.generate",
+                                             cat="serve"):
+                            ok, err, times, _fin = \
+                                _lm_stream_once(conn, body)
+                    else:
+                        ok, err, times, _fin = _lm_stream_once(conn, body)
+                except OSError as e:
+                    conn.close()
+                    conn = ep.connect()
+                    col.fail(f"{type(e).__name__}: {e}")
+                    continue
+                if ok:
+                    col.ok(sched, times, time.perf_counter())
+                else:
+                    col.fail(err)
+        finally:
+            conn.close()
+
+    workers = min(max_workers, max(4, int(qps * 4)))
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(workers)]
+    for t in threads:
+        t.start()
+    for i in range(n):
+        slots.put(t0 + i * interval)
+    for _ in threads:
+        slots.put(None)
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    done = len(col.e2e)
+    out = {"mode": "lm-open", "duration_s": round(wall, 3),
+           "qps_target": round(qps, 2), "workers": workers,
+           "requests": done + col.failures, "ok": done,
+           "failures": col.failures,
+           "qps_achieved": round(done / wall, 2) if wall else 0.0,
+           "tokens": col.tokens,
+           "tokens_per_sec": round(col.tokens / wall, 2) if wall else 0.0,
+           "ttft_ms": latency_summary(col.ttft),
+           "intertoken_ms": latency_summary(col.intertoken)}
+    out.update(latency_summary(col.e2e))
+    if col.errors:
+        out["errors"] = col.errors
+    return out
+
+
+def run_lm_bench(url: str, prompt_len: int = 8, max_new: int = 16,
+                 vocab: int = 16, duration_s: float = 10.0,
+                 qps: float = 4.0, warmup_s: float = 2.0,
+                 version: Optional[str] = None, note: str = "") -> Dict:
+    """LM serving bench artifact (``SERVE_r*.json``, lm schema):
+    sequential warmup (populates the prefill/decode compile cells),
+    then one open-loop streamed phase. Headline numbers are
+    tokens/sec, TTFT p50/p99 and inter-token p50/p99."""
+    ep = _Endpoint(url)
+    body = make_lm_payload(prompt_len, vocab, max_new, version=version)
+    doc: Dict = {
+        "schema": "cxxnet-lm-serve-bench-v1",
+        "url": url, "mode": "lm-open",
+        "prompt_len": prompt_len, "max_new": max_new, "note": note,
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    doc["healthz_before"] = ep.get_json("/healthz")
+    if warmup_s > 0:                 # sequential: warm, not loaded
+        stop = time.perf_counter() + warmup_s
+        conn = ep.connect()
+        try:
+            while time.perf_counter() < stop:
+                _lm_stream_once(conn, body)
+        finally:
+            conn.close()
+    s_before = ep.get_json("/statz")
+    phase = run_lm_open(url, body, duration_s, qps)
+    s_after = ep.get_json("/statz")
+    doc["phases"] = {"lm_open": phase}
+    # LM scheduler snapshots ride /statz (stats.lm hook): keep the
+    # after-side view (KV occupancy, compile hit/miss) as evidence the
+    # run had zero steady-state recompiles
+    lm_views = [r["stats"]["lm"] for r in s_after.get("replicas", ())
+                if isinstance(r.get("stats"), dict) and "lm" in r["stats"]]
+    if not lm_views and "lm" in s_after:
+        lm_views = [s_after["lm"]]
+    if lm_views:
+        doc["lm_statz_after"] = lm_views
+        before_miss = sum(
+            r["stats"]["lm"]["compile"]["misses"]
+            for r in s_before.get("replicas", ())
+            if isinstance(r.get("stats"), dict) and "lm" in r["stats"])
+        if not before_miss and "lm" in s_before:
+            before_miss = s_before["lm"]["compile"]["misses"]
+        after_miss = sum(v["compile"]["misses"] for v in lm_views)
+        doc["steady_state_recompiles"] = int(after_miss - before_miss)
+    doc["tokens_per_sec"] = phase["tokens_per_sec"]
+    doc["ttft_p50_ms"] = phase["ttft_ms"]["p50_ms"]
+    doc["ttft_p99_ms"] = phase["ttft_ms"]["p99_ms"]
+    doc["intertoken_p50_ms"] = phase["intertoken_ms"]["p50_ms"]
+    doc["intertoken_p99_ms"] = phase["intertoken_ms"]["p99_ms"]
+    doc["failures"] = phase["failures"]
+    return doc
+
+
 # -- statz deltas -------------------------------------------------------------
 
 def statz_fill_delta(before: dict, after: dict) -> Dict:
@@ -417,8 +638,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="closed-loop workers")
     ap.add_argument("--rows", type=int, default=1,
                     help="rows per request")
-    ap.add_argument("--width", type=int, required=True,
-                    help="flat row width (= c*y*x of the model input)")
+    ap.add_argument("--width", type=int, default=0,
+                    help="flat row width (= c*y*x of the model input); "
+                         "required unless --lm")
+    ap.add_argument("--lm", action="store_true",
+                    help="bench /generate token streaming instead of "
+                         "/predict (open-loop only; TTFT + inter-token "
+                         "percentiles, tokens/sec)")
+    ap.add_argument("--prompt-len", type=int, default=8,
+                    help="[--lm] tokens per prompt")
+    ap.add_argument("--max-new", type=int, default=16,
+                    help="[--lm] decode budget per request")
+    ap.add_argument("--vocab", type=int, default=16,
+                    help="[--lm] prompt token ids drawn from [1, vocab)")
     ap.add_argument("--raw", action="store_true",
                     help="request probability rows instead of classes")
     ap.add_argument("--version", default="",
@@ -436,12 +668,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = ap.parse_args(argv)
     if args.trace_out:
         enable_tracing(args.trace_out)
-    doc = run_bench(args.url, mode=args.mode, qps=args.qps,
-                    duration_s=args.duration,
-                    concurrency=args.concurrency, rows=args.rows,
-                    width=args.width, raw=args.raw,
-                    version=args.version or None,
-                    warmup_s=args.warmup, note=args.note)
+    if args.lm:
+        doc = run_lm_bench(args.url, prompt_len=args.prompt_len,
+                           max_new=args.max_new, vocab=args.vocab,
+                           duration_s=args.duration,
+                           qps=args.qps or 4.0,
+                           warmup_s=args.warmup,
+                           version=args.version or None, note=args.note)
+    else:
+        if args.width <= 0:
+            ap.error("--width is required unless --lm")
+        doc = run_bench(args.url, mode=args.mode, qps=args.qps,
+                        duration_s=args.duration,
+                        concurrency=args.concurrency, rows=args.rows,
+                        width=args.width, raw=args.raw,
+                        version=args.version or None,
+                        warmup_s=args.warmup, note=args.note)
     if args.trace_out:
         dump_trace()
     line = json.dumps(doc, sort_keys=True)

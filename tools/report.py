@@ -169,8 +169,12 @@ def section_incidents(events: List[Dict], out: List[str],
     incidents = [e for e in events if e.get("event") not in
                  ("round_end", "compile", "ckpt_save", "ckpt_load",
                   "run_start", "run_end",
-                  # serving lifecycle renders in its own timeline
+                  # serving lifecycle renders in its own timeline;
+                  # LM-serving events are routine lifecycle too (a
+                  # deadline/cancel eviction is the protocol working,
+                  # not an incident)
                   "serve_start", "weights_reload", "replica_state",
+                  "lm_serve_start", "kv_evict", "prefill_handoff",
                   # elastic lifecycle renders in the topology timeline
                   "elastic_join", "elastic_leave", "topology_change",
                   "elastic_resume", "elastic_advice",
