@@ -137,10 +137,14 @@ class HandoffListener:
                 return                      # socket closed: shutdown
             t = threading.Thread(target=self._serve_conn, args=(conn,),
                                  daemon=True, name="lm-handoff-conn")
+            # prune finished handlers: a long-lived replica must not
+            # hold one dead thread object per handoff it ever served
+            self._conns = [c for c in self._conns if c.is_alive()]
             self._conns.append(t)
             t.start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        handle = None
         try:
             with conn:
                 hdr, arrays = recv_frame(
@@ -163,10 +167,21 @@ class HandoffListener:
                         "event": "error", "reason": "rejected",
                         "error": str(exc)})
                     return
-                for ev in handle.events(timeout=_RELAY_TIMEOUT_S):
+                # relay budget follows the request's own deadline (the
+                # scheduler evicts first and terminates the stream);
+                # the flat cap only backstops deadline-less requests
+                relay_s = _RELAY_TIMEOUT_S
+                dl_ms = float(hdr.get("deadline_ms") or 0.0)
+                if dl_ms:
+                    relay_s = dl_ms / 1e3 + 5.0
+                for ev in handle.events(timeout=relay_s):
                     self._send_event(conn, ev)
         except (WireError, OSError, TimeoutError):
-            pass                            # peer gone; nothing to tell it
+            # peer gone or stream wedged: nothing to tell the peer, but
+            # the local sequence must not keep a decode row + KV blocks
+            # generating into a dead connection
+            if handle is not None:
+                handle.cancel()
 
     @staticmethod
     def _send_event(conn: socket.socket, ev: Dict) -> None:

@@ -108,7 +108,7 @@ class Sequence:
 
     __slots__ = ("seq_id", "prompt", "max_new", "deadline", "handle",
                  "table", "blocks", "p0", "generated", "admitted_at",
-                 "row", "remote_src")
+                 "row", "remote_src", "finished")
 
     def __init__(self, seq_id: int, prompt: np.ndarray, max_new: int,
                  deadline: Optional[float], handle: StreamHandle, T: int):
@@ -124,6 +124,7 @@ class Sequence:
         self.admitted_at = time.monotonic()
         self.row: Optional[int] = None
         self.remote_src = False           # admitted via handoff
+        self.finished = False             # terminal; _finish ran
 
 
 class LMScheduler:
@@ -199,7 +200,9 @@ class LMScheduler:
             self._thread.join(timeout=timeout)
         # the loop may have exited before seeing the cancel flags —
         # sweep once more so every outstanding handle terminates and
-        # every block goes back to the pool
+        # every block goes back to the pool (safe even if the join
+        # timed out and the loop is still running: _finish is
+        # idempotent, so a racing double-finish is a no-op)
         self._sweep_expired()
         if self.listener is not None:
             self.listener.stop()
@@ -281,7 +284,11 @@ class LMScheduler:
             raise
         seq.p0 = prompt_len
         self._first_token(seq, int(first_token))
-        if seq.generated:          # not already finished by eos/limits
+        # same guard as _prefill_chunk: _first_token may have finished
+        # the sequence (eos / max_new <= 1), in which case its blocks
+        # are already freed and queueing it would run a second decode
+        # lifecycle on a terminal sequence
+        if seq.generated and seq.blocks:
             with self._lock:
                 self._ready.append(seq)
             self._wake.set()
@@ -317,8 +324,14 @@ class LMScheduler:
     def _finish(self, seq: Sequence, reason: str) -> None:
         """Terminal bookkeeping shared by every exit path: exactly the
         sequence's own blocks go back to the pool, its row frees, and
-        its handle gets the terminal event."""
+        its handle gets the terminal event. Idempotent: the first
+        caller wins (the flag is checked-and-set under the lock), so a
+        stop()-time sweep racing the scheduler loop can never double-
+        free blocks, underflow _live, or emit a second terminal event."""
         with self._lock:
+            if seq.finished:
+                return
+            seq.finished = True
             if seq.row is not None:
                 self._active.pop(seq.row, None)
                 self._free_rows.append(seq.row)
@@ -490,6 +503,10 @@ class LMScheduler:
 
         t = threading.Thread(target=relay, daemon=True,
                              name=f"lm-handoff-{seq.seq_id}")
+        # prune finished relays so a long-lived prefill replica doesn't
+        # accumulate one dead thread object per handed-off sequence
+        self._ship_threads = [s for s in self._ship_threads
+                              if s.is_alive()]
         self._ship_threads.append(t)
         t.start()
 

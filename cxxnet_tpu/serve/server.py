@@ -200,7 +200,17 @@ def _make_handler(server: "ServeServer"):
                 [int(t) for t in prompt], max_new=req.get("max_new"),
                 deadline_ms=req.get("deadline_ms"), version=version)
             if not int(req.get("stream", 1)):
-                done = handle.result(timeout=server.result_timeout_s)
+                try:
+                    done = handle.result(timeout=server.result_timeout_s)
+                except TimeoutError:
+                    # slow generation outlived the handler budget: evict
+                    # it (freeing its decode row + KV blocks) instead of
+                    # letting it run on after the client got an error,
+                    # and surface the standard 504 like any deadline
+                    handle.cancel()
+                    raise DeadlineExceeded(
+                        "generation exceeded result_timeout_s="
+                        f"{server.result_timeout_s}")
                 with TRACER.span("serve.respond", cat="serve"):
                     self._reply(200, {"tokens": done["tokens"],
                                       "reason": done["reason"]})

@@ -236,6 +236,45 @@ def test_scheduler_token_events_stream_incrementally(lm):
     assert evs[-1]["tokens"] == [e["token"] for e in evs[:-1]]
 
 
+def test_handoff_terminal_first_token_not_requeued(lm):
+    # regression: a handed-off sequence whose shipped first token is
+    # already terminal (max_new=1 / eos) must finish exactly once —
+    # not get queued for a second decode lifecycle that would double-
+    # finish, underflow live_count, and wedge idle-based drain gating
+    lme, cfg = lm
+    pool = lme.block_pool
+    prompt = prompts(1, lo=4, hi=5, seed=13)[0]
+    # prefill locally (standing in for the prefill plane) to get KV
+    need = pool.blocks_for_tokens(prompt.size)
+    blocks = pool.alloc(need, 999)
+    table = np.zeros((lme.T,), np.int32)
+    table[:need] = blocks
+    p0 = 0
+    tok = 0
+    while p0 < prompt.size:
+        c = min(cfg.prefill_chunk, prompt.size - p0)
+        ids = np.zeros((cfg.prefill_chunk,), np.int32)
+        ids[:c] = prompt[p0:p0 + c]
+        tok = lme.run_prefill(table, ids, p0, c)
+        p0 += c
+    kv = lme.extract_kv(table)
+    pool.free(blocks)
+    assert pool.used == 0
+    sched = LMScheduler(lme, cfg)
+    sched.start()
+    try:
+        h = sched.admit_handoff(prompt.size, int(tok), 1, 0.0, kv)
+        out = h.result(timeout=30)
+        assert out["event"] == "done"
+        assert out["tokens"] == [int(tok)]
+        time.sleep(0.3)          # give a buggy requeue time to decode
+        assert sched.live_count() == 0    # not negative, not positive
+        assert pool.used == 0
+        assert h._q.empty()      # exactly one terminal event, no strays
+    finally:
+        sched.stop(drain=True)
+
+
 def test_pressure_eviction_frees_exactly_victim_blocks(mesh1):
     # 4 usable blocks of 4 tokens: two sequences that each want 3+
     # blocks cannot coexist — the most-recently-admitted one must be
