@@ -38,7 +38,7 @@ from .core import (Finding, LintPass, Project, build_parents,
 NAMESPACE_PREFIXES = ("serve_", "telemetry_", "elastic_", "io_retry_",
                       "fsdp_", "shard_ckpt", "compile_cache",
                       "data_service", "health_", "deploy_", "replay_",
-                      "lm_serve", "kv_")
+                      "lm_serve", "kv_", "quant_", "cascade_")
 
 _FN = (ast.FunctionDef, ast.AsyncFunctionDef)
 

@@ -113,7 +113,8 @@ def _digest(arr: np.ndarray) -> str:
 def save_model(path: str, *, structure_sig: tuple, round_counter: int,
                epoch_counter: int, params: Any, net_state: Any,
                opt_state: Optional[Any] = None, step_count: int = 0,
-               lr_scale: float = 1.0) -> None:
+               lr_scale: float = 1.0,
+               extra_meta: Optional[Dict[str, Any]] = None) -> None:
     # distributed-trace root for the save: the ckpt_save ledger event
     # emitted in the finally block runs INSIDE it, so the incident
     # timeline row carries the save's trace id (trace_assemble /
@@ -128,7 +129,8 @@ def save_model(path: str, *, structure_sig: tuple, round_counter: int,
                         round_counter=round_counter,
                         epoch_counter=epoch_counter, params=params,
                         net_state=net_state, opt_state=opt_state,
-                        step_count=step_count, lr_scale=lr_scale)
+                        step_count=step_count, lr_scale=lr_scale,
+                        extra_meta=extra_meta)
             ok = True
         finally:
             # histogram recorded on the WRITING thread (covers the
@@ -142,7 +144,8 @@ def save_model(path: str, *, structure_sig: tuple, round_counter: int,
 def _save_model(path: str, *, structure_sig: tuple, round_counter: int,
                 epoch_counter: int, params: Any, net_state: Any,
                 opt_state: Optional[Any] = None, step_count: int = 0,
-                lr_scale: float = 1.0) -> None:
+                lr_scale: float = 1.0,
+                extra_meta: Optional[Dict[str, Any]] = None) -> None:
     failpoints.check("ckpt.write", IOError)
     arrays: Dict[str, np.ndarray] = {}
     _flatten("params", jax_to_numpy(params), arrays)
@@ -165,6 +168,14 @@ def _save_model(path: str, *, structure_sig: tuple, round_counter: int,
         "has_opt": opt_state is not None,
         "digests": {k: _digest(v) for k, v in arrays.items()},
     }
+    if extra_meta:
+        # derived-round annotations (e.g. __quant_meta__ from quant/ptq):
+        # extra keys may not shadow the reserved checkpoint fields above
+        clash = set(extra_meta) & set(meta)
+        if clash:
+            raise ValueError(
+                f"extra_meta keys clash with checkpoint meta: {clash}")
+        meta.update(extra_meta)
     arrays["__meta__"] = np.frombuffer(
         json.dumps(meta).encode("utf-8"), dtype=np.uint8)
     buf = io.BytesIO()
@@ -285,6 +296,21 @@ def blob_digest(meta: Dict[str, Any]) -> str:
     for k in sorted(digests):
         h.update(f"{k}={digests[k]};".encode("ascii"))
     return h.hexdigest()[:12]
+
+
+def quant_meta(meta: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """The ``__quant_meta__`` block of a derived (post-training
+    quantized) round, or None for ordinary checkpoints. Carries the
+    provenance chain (source round + blob_digest), the calibration
+    config, and per-leaf drift metrics (quant/ptq.py writes it;
+    tools/ckpt_health.py and deploy's offline gate read it)."""
+    qm = meta.get("__quant_meta__")
+    return qm if isinstance(qm, dict) else None
+
+
+def is_quantized(meta: Dict[str, Any]) -> bool:
+    """Whether this checkpoint is a PTQ-derived int8 round."""
+    return quant_meta(meta) is not None
 
 
 def verify_model(path: str) -> Dict[str, Any]:

@@ -446,6 +446,87 @@ def parse_lm_serve_config(cfg: ConfigPairs) -> LMServeConfig:
     return lc
 
 
+@dataclasses.dataclass(frozen=True)
+class QuantConfig:
+    """The ``quant_*`` / ``cascade_*`` knob set (doc/tasks.md
+    "Quantized serving & cascade"): post-training int8 quantization
+    calibration, the drift-verdict thresholds deploy gates on, and the
+    two-tier confidence-cascade router. Same validated-namespace
+    contract as ``serve_*`` — a typo'd key raises instead of silently
+    serving with defaults."""
+    calib_batches: int = 4        # quant_calib_batches: activation calib
+    calib_percentile: float = 100.0  # quant_calib_percentile (100=absmax)
+    max_rel_err: float = 0.05     # quant_max_rel_err: drift gate (RMS)
+    max_sat_frac: float = 0.05    # quant_max_sat_frac: |q|==127 fraction
+    parity_tol: float = 0.02      # quant_parity_tol: int8-vs-fp accuracy
+    # -- cascade (two-tier confidence routing) -------------------------
+    cascade_enable: int = 0       # cascade_enable: 1 = route via cascade
+    cascade_threshold: float = 0.5  # cascade_threshold: escalate below
+    cascade_metric: str = "margin"  # cascade_metric: margin|entropy
+    cascade_model: str = ""       # cascade_model: fast-tier (quantized)
+    #   checkpoint path ('' = derive by quantizing the flagship blob)
+    cascade_replicas: int = 1     # cascade_replicas: fast-tier size
+
+
+def parse_quant_config(cfg: ConfigPairs) -> QuantConfig:
+    """Collect/validate the ``quant_*`` / ``cascade_*`` keys (last
+    occurrence wins; unknown keys in either namespace fail fast)."""
+    known = {
+        "quant_calib_batches": ("calib_batches", int),
+        "quant_calib_percentile": ("calib_percentile", float),
+        "quant_max_rel_err": ("max_rel_err", float),
+        "quant_max_sat_frac": ("max_sat_frac", float),
+        "quant_parity_tol": ("parity_tol", float),
+        "cascade_enable": ("cascade_enable", int),
+        "cascade_threshold": ("cascade_threshold", float),
+        "cascade_metric": ("cascade_metric", str),
+        "cascade_model": ("cascade_model", str),
+        "cascade_replicas": ("cascade_replicas", int),
+    }
+    vals = {}
+    for name, val in cfg:
+        if name.startswith("quant_") or name.startswith("cascade_"):
+            if name not in known:
+                raise ConfigError(
+                    f"unknown quant/cascade setting {name!r}; valid "
+                    "keys: " + ", ".join(sorted(known)))
+            field, conv = known[name]
+            try:
+                vals[field] = conv(val)
+            except ValueError as e:
+                raise ConfigError(f"bad {name} value {val!r}: {e}")
+    qc = QuantConfig(**vals)
+    if qc.calib_batches < 1:
+        raise ConfigError(
+            f"quant_calib_batches must be >= 1, got {qc.calib_batches}")
+    if not 0.0 < qc.calib_percentile <= 100.0:
+        raise ConfigError(
+            "quant_calib_percentile must be in (0, 100], got "
+            f"{qc.calib_percentile}")
+    if qc.max_rel_err <= 0 or qc.max_sat_frac < 0:
+        raise ConfigError(
+            "quant_max_rel_err must be > 0 and quant_max_sat_frac "
+            f">= 0, got {qc.max_rel_err}/{qc.max_sat_frac}")
+    if qc.parity_tol <= 0:
+        raise ConfigError(
+            f"quant_parity_tol must be > 0, got {qc.parity_tol}")
+    if qc.cascade_enable not in (0, 1):
+        raise ConfigError(
+            f"cascade_enable must be 0 or 1, got {qc.cascade_enable}")
+    if not 0.0 < qc.cascade_threshold < 1.0:
+        raise ConfigError(
+            "cascade_threshold must be in (0, 1), got "
+            f"{qc.cascade_threshold}")
+    if qc.cascade_metric not in ("margin", "entropy"):
+        raise ConfigError(
+            f"cascade_metric must be margin|entropy, got "
+            f"{qc.cascade_metric!r}")
+    if qc.cascade_replicas < 1:
+        raise ConfigError(
+            f"cascade_replicas must be >= 1, got {qc.cascade_replicas}")
+    return qc
+
+
 # -- sharding -----------------------------------------------------------------
 
 @dataclasses.dataclass(frozen=True)

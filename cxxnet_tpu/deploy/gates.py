@@ -52,24 +52,57 @@ class GateResult:
 # -- offline gate --------------------------------------------------------------
 
 def offline_gate(candidate_blob, incumbent_blob, cfg: DeployConfig,
-                 digest_c: str = "", digest_i: str = "") -> GateResult:
+                 digest_c: str = "", digest_i: str = "",
+                 quant_cfg=None) -> GateResult:
     """Library ckpt_health verdict over candidate vs incumbent (or the
     candidate alone when no incumbent checkpoint exists). UNSAFE fails
     the gate; SUSPECT passes with ``details["suspect"] = True`` so the
-    controller extends the canary window."""
+    controller extends the canary window.
+
+    A PTQ-derived candidate (``__quant_meta__`` in its meta,
+    checkpoint.is_quantized) additionally runs the quantization-drift
+    verdict (quant/ptq.py ``drift_verdict``): a drift-unsafe quantized
+    round never reaches a canary, regardless of what the layer-stat
+    comparison says. For that comparison the candidate is dequantized
+    first — int8 leaves + scale vectors would otherwise diff
+    structurally against an fp incumbent."""
+    from .. import checkpoint as ckpt
+    from ..config import QuantConfig
+    from ..quant import dequantize_blob, drift_verdict
+    qm = ckpt.quant_meta(candidate_blob["meta"]) \
+        if isinstance(candidate_blob, dict) else None
+    drift = None
+    if qm is not None:
+        qc = quant_cfg or QuantConfig()
+        drift = drift_verdict(qm, qc.max_rel_err, qc.max_sat_frac)
+        candidate_blob = dequantize_blob(candidate_blob)
     res = reload_verdict(incumbent_blob, candidate_blob,
                          max_ratio=cfg.max_ratio,
                          digest_a=digest_i, digest_b=digest_c) \
         if incumbent_blob is not None else \
         reload_verdict(candidate_blob, max_ratio=cfg.max_ratio,
                        digest_a=digest_c)
+    passed = res["exit_code"] != 2
+    reason = res["line"]
+    details = {"verdict": res["verdict"],
+               "suspect": res["exit_code"] == 1,
+               "worst": res["worst"]}
+    layers = list(res["layers"])
+    if drift is not None:
+        details["quant_drift"] = {  # graftlint: disable=config-namespace (gate-detail field, not a config key)
+            "verdict": drift["verdict"],
+            "worst_rel_err": drift["worst_rel_err"],
+            "worst_sat_frac": drift["worst_sat_frac"],
+            "source_round": drift["source_round"],
+            "source_digest": drift["source_digest"]}
+        reason += "; " + drift["line"]
+        if not drift["ok"]:
+            passed = False
+            layers += [r["layer"] for r in drift["layers"]
+                       if not r["ok"]]
     return GateResult(
-        gate="offline", passed=res["exit_code"] != 2,
-        reason=res["line"],
-        details={"verdict": res["verdict"],
-                 "suspect": res["exit_code"] == 1,
-                 "worst": res["worst"]},
-        layers=res["layers"], provenance=res["provenance"])
+        gate="offline", passed=passed, reason=reason,
+        details=details, layers=layers, provenance=res["provenance"])
 
 
 # -- online gates --------------------------------------------------------------

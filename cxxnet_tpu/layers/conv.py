@@ -80,6 +80,25 @@ class ConvolutionLayer(Layer):
 
     def apply(self, params, state, inputs, ctx):
         hp = self.hp
+        if "wmat_scale" in params:
+            # PTQ-derived int8 weights (quant/ptq.py): the int8 conv
+            # bypasses the s2d fold (cin packing buys nothing once the
+            # contraction is int8) but keeps the stem cin_pad — int8
+            # zero-pad of the I dim is exact, same as the fp path
+            from ..ops.fused_quant import int8_conv
+            x, w = inputs[0], params["wmat"]
+            if (ctx.cin_pad and hp.num_group == 1
+                    and x.shape[-1] < ctx.cin_pad):
+                padc = ctx.cin_pad - x.shape[-1]
+                x = jnp.pad(x, ((0, 0), (0, 0), (0, 0), (0, padc)))
+                w = jnp.pad(w, ((0, 0), (0, 0), (0, padc), (0, 0)))
+            y = int8_conv(
+                x, w, params["wmat_scale"], params["act_scale"],
+                params.get("bias"), ctx.fuse_act or "none",
+                strides=(hp.stride, hp.stride),
+                padding=((hp.pad_y, hp.pad_y), (hp.pad_x, hp.pad_x)),
+                groups=hp.num_group)
+            return [y], state
         x = inputs[0].astype(ctx.compute_dtype)
         w = params["wmat"].astype(ctx.compute_dtype)
         # stem channel padding (graph.stem_pad_plan via ctx.cin_pad):

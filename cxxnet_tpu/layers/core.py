@@ -60,6 +60,16 @@ class FullConnectLayer(Layer):
 
     def apply(self, params, state, inputs, ctx):
         x = _flat2d(inputs[0])
+        if "wmat_scale" in params:
+            # PTQ-derived int8 weights (quant/ptq.py): static-scale
+            # activation quantization + int8 x int8 -> int32 matmul +
+            # fused dequant/bias/act epilogue (ops/fused_quant.py)
+            from ..ops.fused_quant import int8_matmul
+            y = int8_matmul(x, params["wmat"], params["wmat_scale"],
+                            params["act_scale"], params.get("bias"),
+                            ctx.fuse_act or "none",
+                            fused=ctx.fused, spmd=ctx.fused_spmd)
+            return [_as_node(y)], state
         w = params["wmat"].astype(ctx.compute_dtype)
         y = jnp.dot(x.astype(ctx.compute_dtype), w)
         bias = params.get("bias")

@@ -335,7 +335,19 @@ class SeqFCLayer(Layer, _SeqLinearMixin):
         return {"wmat": (None, "model"), "bias": ("model",)}
 
     def apply(self, params, state, inputs, ctx):
-        x = _seq(inputs[0]).astype(ctx.compute_dtype)
+        x = _seq(inputs[0])
+        if "wmat_scale" in params:
+            # PTQ-derived int8 weights (quant/ptq.py): positions fold
+            # into rows so the projection runs as one int8 matmul with
+            # the fused dequant/bias epilogue (ops/fused_quant.py)
+            from ..ops.fused_quant import int8_matmul
+            b, s, e = x.shape
+            y2 = int8_matmul(x.reshape(b * s, e), params["wmat"],
+                             params["wmat_scale"], params["act_scale"],
+                             params.get("bias"), "none",
+                             fused=ctx.fused, spmd=None)
+            return [_unseq(y2.reshape(b, s, -1))], state
+        x = x.astype(ctx.compute_dtype)
         y = jnp.einsum("bse,ek->bsk", x,
                        params["wmat"].astype(ctx.compute_dtype))
         if "bias" in params:

@@ -1178,13 +1178,27 @@ class LearnTask:
         serve_ab) builds a replica pool with SLO-aware routing and the
         checkpoint hot-reload watcher. Blocks until SIGINT/SIGTERM,
         then drains before exiting."""
-        from .config import ConfigError, parse_serve_config
+        from .config import (ConfigError, parse_quant_config,
+                             parse_serve_config)
         from .deploy import DeployController, parse_deploy_config
-        from .serve import InferenceEngine, ReloadWatcher, ReplicaPool
-        from .serve.engine import restore_inference_blob
+        from .serve import (CascadeRouter, InferenceEngine, ReloadWatcher,
+                            ReplicaPool)
+        from .serve.engine import negotiate_blob, restore_inference_blob
         from .serve.server import ServeServer
         sc = parse_serve_config(self.global_cfg)
         dc = parse_deploy_config(self.global_cfg)
+        qc = parse_quant_config(self.global_cfg)
+        if qc.cascade_enable:
+            if dc.enable or sc.reload_s > 0:
+                raise ConfigError(
+                    "cascade_enable = 1 does not compose with "
+                    "deploy_enable/serve_reload_s yet: the cascade "
+                    "tiers pin their versions, a reload would swap "
+                    "them out from under the router")
+            if not qc.cascade_model:
+                raise ConfigError(
+                    "cascade_enable = 1 needs cascade_model = <path to "
+                    "a quantized round> (tools/quantize.py derives one)")
         if dc.enable:
             # the controller owns canary reloads end to end: a plain
             # reload watcher racing it would ship ungated rounds
@@ -1230,7 +1244,45 @@ class LearnTask:
             # the bf16 matmul rate); default = the net's policy
             dtype=sc.dtype or None)
         watcher = None
-        if sc.fleet:
+        if qc.cascade_enable:
+            # two-tier confidence cascade (doc/tasks.md "Quantized
+            # serving & cascade"): the flagship blob is the model
+            # loaded above, the fast tier loads the PTQ-derived round
+            # named by cascade_model. The router IS a pool, so the
+            # server front-end is unchanged.
+            if blob is None:
+                raise ConfigError(
+                    "cascade_enable = 1 needs a flagship model "
+                    "(model_in or continue = 1)")
+            fast_blob = ckpt.load_for_inference(qc.cascade_model)
+            pool = CascadeRouter.build_two_tier(
+                self.global_cfg,
+                flagship_blob=blob,
+                flagship_digest=ckpt.blob_digest(blob["meta"]),
+                fast_blob=fast_blob,
+                fast_digest=ckpt.blob_digest(fast_blob["meta"]),
+                qc=qc, n_flagship=sc.replicas,
+                n_fast=qc.cascade_replicas,
+                flagship_dtype=sc.dtype or None,
+                admission_control=bool(sc.admission),
+                max_latency_ms=sc.max_latency_ms,
+                max_queue_rows=sc.queue_rows,
+                default_timeout_ms=sc.timeout_ms or None,
+                breaker_threshold=sc.breaker_threshold,
+                breaker_reset_s=sc.breaker_reset_s,
+                degraded_queue_frac=sc.degraded_queue_frac,
+                slo_ms=sc.slo_ms, slo_target=sc.slo_target,
+                slo_window_s=sc.slo_window_s,
+                slo_burn_degraded=sc.slo_burn_degraded,
+                silent=bool(self.silent),
+                # per-tier dtype is the whole point here: the fast
+                # tier is pinned int8, the flagship follows serve_dtype
+                **{k: v for k, v in common.items() if k != "dtype"})
+            srv = ServeServer(
+                pool=pool, port=sc.port, host=sc.host,
+                log_interval_s=sc.log_interval_s,
+                silent=bool(self.silent))
+        elif sc.fleet:
             pool = ReplicaPool.build(
                 self.global_cfg, sc.replicas, blob=blob,
                 digest=ckpt.blob_digest(blob["meta"]) if blob else "",
@@ -1271,7 +1323,11 @@ class LearnTask:
                 silent=bool(self.silent))
         else:
             if blob is not None:
-                restore_inference_blob(self.trainer, blob)
+                # dtype negotiation (serve.engine.negotiate_blob):
+                # serve_dtype=int8 demands a PTQ-derived round; an fp
+                # engine dequantizes a quantized one on load
+                restore_inference_blob(
+                    self.trainer, negotiate_blob(blob, sc.dtype or None))
             else:
                 self.trainer.init_model()
             engine = InferenceEngine(self.trainer, **common)
@@ -1279,7 +1335,8 @@ class LearnTask:
                 from .serve.engine import version_name
                 engine.weights_digest = ckpt.blob_digest(blob["meta"])
                 engine.weights_version = version_name(
-                    blob["meta"]["round"])
+                    blob["meta"]["round"]) \
+                    + ("-int8" if engine.serve_int8 else "")
             srv = ServeServer(
                 engine,
                 port=sc.port, host=sc.host,

@@ -1,0 +1,16 @@
+"""Post-training quantization (doc/tasks.md "Quantized serving &
+cascade"): PTQ pass over verified checkpoints, drift verdicts, and the
+dequantize helpers the serve/deploy planes negotiate with."""
+
+from .ptq import (QUANT_LAYER_TYPES, calibrate_act_scales,
+                  dequantize_blob, dequantize_params, drift_verdict,
+                  is_quantized_params, quantizable_layers, quantize_blob,
+                  quantize_params, quantize_weight, weight_drift,
+                  write_quantized_round)
+
+__all__ = [
+    "QUANT_LAYER_TYPES", "calibrate_act_scales", "dequantize_blob",
+    "dequantize_params", "drift_verdict", "is_quantized_params",
+    "quantizable_layers", "quantize_blob", "quantize_params",
+    "quantize_weight", "weight_drift", "write_quantized_round",
+]

@@ -22,15 +22,19 @@ philosophy to request-driven prediction:
   burn rate, admission control, A/B version pinning);
 * :mod:`.reload`  — ``ReloadWatcher``: zero-downtime hot weight reload
   from the checkpoint directory (verified scan, rolling drain+swap,
-  A/B canary subsets).
+  A/B canary subsets);
+* :mod:`.cascade` — ``CascadeRouter``: confidence-routed two-tier
+  serving (int8 tier answers high-confidence rows, the rest escalate
+  to the flagship tier — doc/tasks.md "Quantized serving & cascade").
 """
 
 from ..resilience import CircuitBreaker, CircuitOpen
-from .engine import InferenceEngine
+from .engine import InferenceEngine, negotiate_blob
 from .batcher import MicroBatcher, Backpressure, DeadlineExceeded
 from .stats import ServingStats
 from .fleet import (AllReplicasDegraded, NoHealthyReplica, Replica,
                     ReplicaPool, UnknownVersion)
+from .cascade import CascadeRouter
 from .reload import ReloadWatcher
 from .server import ServeServer
 
@@ -38,4 +42,4 @@ __all__ = ["InferenceEngine", "MicroBatcher", "Backpressure",
            "DeadlineExceeded", "ServingStats", "ServeServer",
            "CircuitBreaker", "CircuitOpen", "ReplicaPool", "Replica",
            "ReloadWatcher", "NoHealthyReplica", "AllReplicasDegraded",
-           "UnknownVersion"]
+           "UnknownVersion", "CascadeRouter", "negotiate_blob"]

@@ -57,6 +57,33 @@ def restore_inference_blob(trainer: Trainer, blob) -> None:
     trainer.epoch_counter = blob["meta"]["epoch"]
 
 
+def negotiate_blob(blob, dtype: Optional[str]):
+    """Dtype negotiation between a loaded inference blob and the
+    engine's requested serving dtype (doc/tasks.md "Quantized serving &
+    cascade"):
+
+    - ``int8`` engine + quantized round: serve as-is (the int8 path).
+    - ``int8`` engine + plain round: ERROR — silent on-the-fly weight
+      quantization would skip calibration and the drift verdict; run
+      tools/quantize.py and serve the derived round.
+    - fp engine + quantized round: dequantize on load (scales folded
+      back into f32 ``wmat``) — a flagship replica can always read a
+      quantized artifact, it just pays fp compute.
+    - fp engine + plain round: pass through.
+    """
+    from ..quant import dequantize_blob, is_quantized_params
+    want_int8 = bool(dtype) and str(dtype).lower() == "int8"
+    quantized = is_quantized_params(blob["params"])
+    if want_int8 and not quantized:
+        raise ValueError(
+            "serve_dtype=int8 but the checkpoint round is not "
+            "quantized (no __quant_meta__/wmat_scale leaves); run "
+            "tools/quantize.py to derive an int8 round first")
+    if quantized and not want_int8:
+        return dequantize_blob(blob)
+    return blob
+
+
 def restore_inference_state(trainer: Trainer, model_path: str,
                             verify: bool = True) -> None:
     """Restore params + layer state onto ``trainer`` from a checkpoint
@@ -127,9 +154,28 @@ class InferenceEngine:
         # checkpoint trained fp32 can SERVE bf16 (params are fp32
         # masters either way — the cast happens inside the compiled
         # predictor). Responses always leave as the policy's fp32
-        # output dtype.
-        self.compute_dtype = (parse_policy(dtype).compute_dtype
-                              if dtype else trainer.net.compute_dtype)
+        # output dtype. ``dtype="int8"`` selects the quantized path:
+        # weights must be a PTQ-derived round (scales in the params
+        # tree, quant/ptq.py); non-quantized interior layers and the
+        # dequant epilogue run f32.
+        self.serve_int8 = bool(dtype) and str(dtype).lower() == "int8"
+        if self.serve_int8:
+            self.compute_dtype = parse_policy("float32").compute_dtype
+        else:
+            self.compute_dtype = (parse_policy(dtype).compute_dtype
+                                  if dtype else trainer.net.compute_dtype)
+        from ..quant import is_quantized_params
+        quantized = is_quantized_params(trainer.params)
+        if self.serve_int8 and not quantized:
+            raise ValueError(
+                "serve_dtype=int8 but the loaded params are not "
+                "quantized (no wmat_scale leaves); run tools/quantize.py "
+                "and serve the derived round")
+        if quantized and not self.serve_int8:
+            raise ValueError(
+                "params are int8-quantized but the engine dtype is "
+                f"{dtype or 'the net policy'}; set serve_dtype=int8 or "
+                "dequantize the blob first (serve.engine.negotiate_blob)")
         dp = trainer.mesh.data_parallel
         self.max_batch = int(max_batch)
         self.buckets = _parse_buckets(buckets, self.max_batch, dp)
@@ -183,9 +229,15 @@ class InferenceEngine:
         pairs = parse_config_string(cfg) if isinstance(cfg, str) \
             else list(cfg)
         tr = Trainer(pairs)
-        restore_inference_state(tr, model_path)
+        blob = ckpt.load_for_inference(model_path)
+        restore_inference_blob(tr, negotiate_blob(blob, kw.get("dtype")))
         eng = cls(tr, **kw)
-        eng.weights_version = version_name(tr.round_counter)
+        eng.weights_digest = ckpt.blob_digest(blob["meta"])
+        # int8 engines serve the DERIVED artifact: the version carries
+        # the dtype suffix so a pin can never conflate the quantized
+        # round with its fp source (cascade tiers route on this)
+        eng.weights_version = version_name(tr.round_counter) \
+            + ("-int8" if eng.serve_int8 else "")
         return eng
 
     # -- shape plumbing --------------------------------------------------
@@ -197,7 +249,23 @@ class InferenceEngine:
         row width against the model's input_shape, and the reshape of
         flat rows onto a non-flat (c,y,x) input."""
         from ..wrapper import _to_nhwc
-        data = np.asarray(data, np.float32)
+        data = np.asarray(data)
+        if data.dtype.kind not in "fiub":
+            # admission-time dtype assert: a non-numeric payload (object
+            # arrays from ragged/str JSON) must 400 here, not explode
+            # inside the compiled call (batcher.submit routes through
+            # this before any queueing)
+            raise ValueError(
+                f"request payload dtype {data.dtype} is not numeric")
+        data = data.astype(np.float32, copy=False)
+        if self.serve_int8 and not np.isfinite(data).all():
+            # int8 replicas quantize activations against a calibrated
+            # static scale: a non-finite row (e.g. an fp64 payload that
+            # overflowed the float32 cast) would silently saturate the
+            # quantizer inside the compiled call — reject at admission
+            raise ValueError(
+                "request payload contains non-finite values after "
+                "float32 cast (int8 replica admission check)")
         c, y, x = self.input_shape
         if data.ndim == 2:
             if data.shape[1] != c * y * x:
@@ -350,6 +418,15 @@ class InferenceEngine:
         one device transfer and zero recompiles. Callers are expected to
         have structure-checked the blob (checkpoint.check_structure) —
         the reload watcher does."""
+        from ..quant import is_quantized_params
+        if is_quantized_params(params) != self.serve_int8:
+            # hot-reload dtype negotiation: an int8 replica must never
+            # silently swap in a plain fp round (and vice versa) — the
+            # compiled closures bake the quantized/fp layer path in
+            raise ValueError(
+                "swap_weights: params quantization does not match the "
+                f"engine dtype (serve_int8={self.serve_int8}); "
+                "negotiate the blob first (serve.engine.negotiate_blob)")
         tr = self.trainer
         placed_p, placed_s = tr._place(params, net_state)
         # swap both references under the dispatch read lock so no device
@@ -359,7 +436,8 @@ class InferenceEngine:
             tr.round_counter = int(round_counter)
             self.weights_round = int(round_counter)
             self.weights_digest = digest
-            self.weights_version = version_name(round_counter)
+            self.weights_version = version_name(round_counter) \
+                + ("-int8" if self.serve_int8 else "")
 
     # -- introspection ---------------------------------------------------
     def node_shape(self, node_name: str = "top") -> Tuple[int, int, int]:

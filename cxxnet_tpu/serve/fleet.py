@@ -287,8 +287,13 @@ class ReplicaPool:
         from ..config import parse_config_string
         from ..parallel import make_mesh_context
         from ..trainer import Trainer
-        from .engine import restore_inference_blob
+        from .engine import negotiate_blob, restore_inference_blob
 
+        if blob is not None:
+            # dtype negotiation ONCE for the whole fleet (not per
+            # replica): int8 engines demand a PTQ-derived round, fp
+            # engines dequantize a quantized one on load
+            blob = negotiate_blob(blob, dtype)
         n = int(n_replicas)
         if n < 1:
             raise ValueError(f"serve_replicas must be >= 1, got {n}")
@@ -313,7 +318,12 @@ class ReplicaPool:
         replicas: List[Replica] = []
         version = "init"
         if blob is not None:
-            version = version_name(blob["meta"]["round"])
+            # the quantized artifact is a distinct version: '-int8'
+            # suffix keeps pins/tiers from conflating it with the fp
+            # source round it derives from
+            version = version_name(blob["meta"]["round"]) \
+                + ("-int8" if bool(dtype)
+                   and str(dtype).lower() == "int8" else "")
         for i, group in enumerate(groups):
             tr = Trainer(pairs, mesh_ctx=make_mesh_context(devices=group))
             if blob is not None:

@@ -512,13 +512,19 @@ class ServeServer:
         if self.pool is None:
             return self.stats.log_line()
         s = self.pool.snapshot()
-        return ("serve-fleet[%dx]\tqps:%.2f\tp50_ms:%.2f\tp99_ms:%.2f"
+        line = ("serve-fleet[%dx]\tqps:%.2f\tp50_ms:%.2f\tp99_ms:%.2f"
                 "\tfill:%.3f\tok:%d\tfailed:%d\tversions:%s" % (
                     len(self.pool.replicas), s["qps"],
                     s["latency_ms"]["p50"], s["latency_ms"]["p99"],
                     s["batches"]["fill_ratio"], s["requests"]["ok"],
                     s["requests"]["failed"],
                     ",".join(sorted(s["versions"]) or ["init"])))
+        if "cascade" in s:
+            # two-tier cascade router (serve/cascade.py): the
+            # escalation rate is the cost-per-request lever, so the
+            # periodic line carries it next to the latency numbers
+            line += "\tesc_rate:%.3f" % s["cascade"]["escalation_rate"]
+        return line
 
     # -- signals ---------------------------------------------------------
     def _install_signal_handlers(self) -> None:

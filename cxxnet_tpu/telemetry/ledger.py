@@ -85,6 +85,10 @@ KNOWN_EVENTS = (
     # LM serving (serve/lm/): scheduler start, per-sequence KV-block
     # eviction (deadline/cancel/pressure), prefill->decode KV handoff
     "lm_serve_start", "kv_evict", "prefill_handoff",
+    # quantized serving (quant/ptq.py, serve/cascade.py): PTQ
+    # calibration of a derived int8 round, and per-request escalation
+    # from the int8 tier to the flagship tier
+    "quant_calibrate", "cascade_escalate",
 )
 
 

@@ -34,6 +34,15 @@ serve-reload sanity verdict:
 Works on both checkpoint formats (``%04d.model`` blobs and ``r%04d``
 shard-set dirs — checkpoint.load_model routes either way).
 
+A PTQ-derived int8 round (``__quant_meta__`` in its meta,
+tools/quantize.py) additionally renders the **quantization-drift
+report**: per-layer weight RMS error and scale-saturation fraction
+recorded at quantization time, judged against ``--quant-max-rel-err``
+/ ``--quant-max-sat-frac`` by the same ``quant.drift_verdict`` the
+deploy offline gate runs — drift UNSAFE exits 2. For a quantized/fp
+diff the quantized side is dequantized first, so the layer tables
+compare real units instead of int8 codes.
+
 Usage:
   python tools/ckpt_health.py A.model [B.model] [--max-ratio 0.5]
       [--json] [--no-verify]
@@ -80,16 +89,44 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--no-verify", action="store_true",
                     help="skip digest verification on load (a corrupt "
                          "archive then reports instead of raising)")
+    ap.add_argument("--quant-max-rel-err", type=float, default=0.05,
+                    help="per-layer quantization RMS error above which "
+                         "a quantized round is drift-UNSAFE")
+    ap.add_argument("--quant-max-sat-frac", type=float, default=0.05,
+                    help="per-layer |q|==127 saturation fraction above "
+                         "which a quantized round is drift-UNSAFE")
     args = ap.parse_args(argv)
+    from cxxnet_tpu import checkpoint as ckpt
+    from cxxnet_tpu.quant import dequantize_blob, drift_verdict
     from cxxnet_tpu.telemetry.modelhealth import reload_verdict
     verify = not args.no_verify
     blob_a, digest_a = load(args.ckpt_a, verify=verify)
     blob_b = digest_b = None
     if args.ckpt_b:
         blob_b, digest_b = load(args.ckpt_b, verify=verify)
+    # quantization-drift verdicts ride the report whenever a side is a
+    # PTQ-derived round; the layer tables/diff below always compare in
+    # real units (the quantized side dequantized), so a quantized-vs-
+    # source diff is structure-compatible instead of trivially UNSAFE
+    drifts: List[Dict[str, Any]] = []
+    sides = [("A", args.ckpt_a, blob_a)]
+    if blob_b is not None:
+        sides.append(("B", args.ckpt_b, blob_b))
+    for tag, path, blob in sides:
+        qm = ckpt.quant_meta(blob["meta"])
+        if qm is not None:
+            dv = drift_verdict(qm, args.quant_max_rel_err,
+                               args.quant_max_sat_frac)
+            drifts.append({"side": tag, "path": path, **dv})
+    if ckpt.is_quantized(blob_a["meta"]):
+        blob_a = dequantize_blob(blob_a)
+    if blob_b is not None and ckpt.is_quantized(blob_b["meta"]):
+        blob_b = dequantize_blob(blob_b)
     res = reload_verdict(blob_a, blob_b, max_ratio=args.max_ratio,
                          digest_a=digest_a, digest_b=digest_b or "")
     vline, rc = res["line"], res["exit_code"]
+    if any(not d["ok"] for d in drifts):
+        rc = 2
     if args.json:
         doc: Dict[str, Any] = {
             "a": {"path": args.ckpt_a, "digest": digest_a,
@@ -103,6 +140,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "leaves": res["b_leaves"]}
             doc["diff"] = res["diff"]
             doc["structure_notes"] = res["structure_notes"]
+        if drifts:
+            doc["quant_drift"] = drifts  # graftlint: disable=config-namespace (report doc field, not a config key)
         print(json.dumps(doc, indent=1, sort_keys=True))
         return rc
     print("A: %s (round %s, digest %s)"
@@ -123,6 +162,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                      d["rel_change"]))
         for n in res["structure_notes"]:
             print("! " + n)
+    for d in drifts:
+        print()
+        print("%s: quantization drift (source round %s, digest %s)"
+              % (d["side"], d.get("source_round", "?"),
+                 d.get("source_digest") or "-"))
+        print("%-40s %12s %12s %6s" % ("layer", "rel rms err",
+                                       "sat frac", "ok"))
+        for r in d["layers"]:
+            print("%-40s %12.5g %12.5g %6s" % (
+                r["layer"], r["rel_err"], r["sat_frac"],
+                "ok" if r["ok"] else "DRIFT"))
+        print(d["line"])
     print()
     print(vline)
     return rc

@@ -538,6 +538,103 @@ def run_lm_bench(url: str, prompt_len: int = 8, max_new: int = 16,
     return doc
 
 
+# -- cascade (two-tier) bench -------------------------------------------------
+
+def run_cascade_bench(url: str, qps: float, duration_s: float = 10.0,
+                      rows: int = 16, width: Optional[int] = None,
+                      warmup_s: float = 2.0, note: str = "") -> Dict:
+    """Cascade serving bench artifact (``SERVE_r*.json``, cascade
+    schema) against a :class:`CascadeRouter` endpoint
+    (``cascade_enable = 1``):
+
+    1. one pinned open-loop phase per tier (the router's version pin
+       bypasses the cascade), giving **per-tier latency percentiles**
+       — what one tier costs when it answers alone;
+    2. one unpinned open-loop phase through the confidence router, with
+       the **escalation rate** taken from the ``/statz`` cascade-counter
+       delta over exactly that window;
+    3. the **cost-per-request** line: every row pays the fast tier and
+       the escalated fraction additionally pays the flagship, so
+       ``cascade ~= fast_p50 + esc_rate * flagship_p50`` vs the
+       flagship-only baseline ``flagship_p50``. On CPU sessions this is
+       a latency-proxy estimate (per the README evidence policy), not
+       an accelerator cost measurement — say so in ``--note``.
+
+    Per-row confidence only varies within a request (all requests share
+    one payload), so use multi-row requests (``rows`` >= 16) for a
+    fractional escalation rate."""
+    if width is None:
+        raise ValueError("cascade bench needs --width (flat request "
+                         "row width = c*y*x of the model input)")
+    if qps <= 0:
+        raise ValueError("cascade bench needs an explicit --qps "
+                         "(there is no closed phase to derive one from)")
+    ep = _Endpoint(url)
+    casc = ep.get_json("/statz").get("cascade")
+    if not casc:
+        raise ValueError("endpoint /statz has no cascade section — is "
+                         "the server fronted by a CascadeRouter "
+                         "(cascade_enable = 1)?")
+    fast_v = casc["fast_version"]
+    flag_v = casc["flagship_version"]
+    body = make_payload(rows, width)
+    doc: Dict = {
+        "schema": "cxxnet-cascade-bench-v1",
+        "url": url, "mode": "cascade", "rows_per_request": rows,
+        "note": note,
+        "cascade_threshold": casc["threshold"],
+        "cascade_metric": casc["metric"],
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    doc["healthz_before"] = ep.get_json("/healthz")
+    if warmup_s > 0:                  # warm both pinned routes + cascade
+        for b in (make_payload(rows, width, version=fast_v),
+                  make_payload(rows, width, version=flag_v), body):
+            run_closed(url, b, max(0.3, warmup_s / 3.0), 2)
+    tiers: Dict[str, Dict] = {}
+    for tier, ver in (("fast", fast_v), ("flagship", flag_v)):
+        ph = run_open(url, make_payload(rows, width, version=ver),
+                      max(1.0, duration_s / 2.0), qps)
+        ph["version"] = ver
+        tiers[tier] = ph
+    s_before = ep.get_json("/statz")
+    cascade_phase = run_open(url, body, duration_s, qps)
+    s_after = ep.get_json("/statz")
+    doc["open_window"] = statz_fill_delta(s_before, s_after)
+    doc["phases"] = {"tier_fast": tiers["fast"],
+                     "tier_flagship": tiers["flagship"],
+                     "cascade": cascade_phase}
+    d_rows = s_after["cascade"]["rows"] - s_before["cascade"]["rows"]
+    d_esc = s_after["cascade"]["rows_escalated"] \
+        - s_before["cascade"]["rows_escalated"]
+    esc_rate = round(d_esc / max(1, d_rows), 6)
+    doc["escalation_rate"] = esc_rate
+    doc["cascade_statz_after"] = s_after["cascade"]  # graftlint: disable=config-namespace (bench artifact field)
+    fast_p50 = tiers["fast"]["p50_ms"]
+    flag_p50 = tiers["flagship"]["p50_ms"]
+    cascade_cost = round(fast_p50 + esc_rate * flag_p50, 3)
+    doc["cost_per_request"] = {
+        "unit": "ms (latency proxy; CPU sessions are estimates)",
+        "fast_p50_ms": fast_p50, "flagship_p50_ms": flag_p50,
+        "escalation_rate": esc_rate,
+        "cascade_ms": cascade_cost,
+        "flagship_only_ms": flag_p50,
+        "savings_pct": round(100.0 * (1.0 - cascade_cost
+                                      / max(flag_p50, 1e-9)), 2),
+        "line": ("cost/request: cascade %.3f ms (= fast %.3f + %.4f x "
+                 "flagship %.3f) vs flagship-only %.3f ms"
+                 % (cascade_cost, fast_p50, esc_rate, flag_p50,
+                    flag_p50)),
+    }
+    doc["qps_sustained"] = cascade_phase["qps_achieved"]
+    doc["p50_ms"] = cascade_phase["p50_ms"]
+    doc["p99_ms"] = cascade_phase["p99_ms"]
+    doc["batch_fill"] = doc["open_window"]["batch_fill"]
+    doc["failures"] = sum(p.get("failures", 0)
+                          for p in doc["phases"].values())
+    return doc
+
+
 # -- statz deltas -------------------------------------------------------------
 
 def statz_fill_delta(before: dict, after: dict) -> Dict:
@@ -645,6 +742,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="bench /generate token streaming instead of "
                          "/predict (open-loop only; TTFT + inter-token "
                          "percentiles, tokens/sec)")
+    ap.add_argument("--cascade", action="store_true",
+                    help="bench a two-tier cascade endpoint "
+                         "(cascade_enable = 1): per-tier pinned phases, "
+                         "escalation rate, cost-per-request; requires "
+                         "--qps, use --rows 16+ for fractional "
+                         "escalation")
     ap.add_argument("--prompt-len", type=int, default=8,
                     help="[--lm] tokens per prompt")
     ap.add_argument("--max-new", type=int, default=16,
@@ -668,7 +771,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = ap.parse_args(argv)
     if args.trace_out:
         enable_tracing(args.trace_out)
-    if args.lm:
+    if args.cascade:
+        if args.width <= 0:
+            ap.error("--width is required with --cascade")
+        doc = run_cascade_bench(args.url, qps=args.qps,
+                                duration_s=args.duration, rows=args.rows,
+                                width=args.width, warmup_s=args.warmup,
+                                note=args.note)
+    elif args.lm:
         doc = run_lm_bench(args.url, prompt_len=args.prompt_len,
                            max_new=args.max_new, vocab=args.vocab,
                            duration_s=args.duration,

@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Post-training int8 quantization of a verified checkpoint (thin CLI).
+
+Drives the PTQ pass (cxxnet_tpu/quant/ptq.py) end to end:
+
+  1. build the model from the training config (the net graph must match
+     the checkpoint — same structure-signature check a serve reload
+     runs);
+  2. load the source round (``checkpoint.load_for_inference`` — digest
+     verified);
+  3. calibrate per-layer activation scales over ``quant_calib_batches``
+     batches from the config's data section (abs-max, optionally
+     percentile-clipped via ``quant_calib_percentile``);
+  4. quantize fullc/conv/seqfc weights per-out-channel symmetric int8
+     and write the **derived round**: same round number, its own
+     digests, ``__quant_meta__`` provenance (source round + digest,
+     calibration config, per-layer drift) riding the meta JSON;
+  5. print the quantization-drift verdict — the same
+     ``quant.drift_verdict`` tools/ckpt_health.py renders and deploy's
+     offline gate enforces. A drift-UNSAFE result still writes the
+     round (so it can be inspected) but exits 2.
+
+The quantized round serves as version ``rNNNN-int8`` under
+``serve_dtype = int8`` (dtype negotiation in serve/engine.py), or as
+the fast tier of a two-tier cascade (``cascade_enable = 1``).
+
+Usage:
+  python tools/quantize.py CONFIG SRC_CKPT OUT_CKPT \
+      [quant_calib_batches=4] [quant_calib_percentile=99.9] [k=v ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import sys
+from typing import List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("config", help="training config (net + data section)")
+    ap.add_argument("src", help="source checkpoint (blob or shard-set dir)")
+    ap.add_argument("out", help="output path for the quantized round")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the drift verdict as JSON")
+    ap.add_argument("overrides", nargs="*",
+                    help="config overrides (key=value), e.g. "
+                         "quant_calib_batches=8")
+    args = ap.parse_args(argv)
+
+    from cxxnet_tpu import checkpoint as ckpt
+    from cxxnet_tpu.config import (parse_cli_overrides, parse_config_file,
+                                   parse_quant_config)
+    from cxxnet_tpu.io.data import close_chain, create_iterator
+    from cxxnet_tpu.main import split_sections
+    from cxxnet_tpu.quant import drift_verdict, quantize_blob, \
+        write_quantized_round
+    from cxxnet_tpu.trainer import Trainer
+
+    cfg = parse_config_file(args.config) + parse_cli_overrides(args.overrides)
+    global_cfg, sections = split_sections(cfg)
+    qc = parse_quant_config(global_cfg)
+
+    tr = Trainer(global_cfg)
+    blob = ckpt.load_for_inference(args.src)
+    ckpt.check_structure(blob["meta"], tr.graph.structure_signature())
+
+    # calibration stream: the config's data section (the distribution
+    # the model actually sees), capped at quant_calib_batches
+    data_pairs = next((p for kind, _n, p in sections if kind == "data"),
+                      None)
+    if data_pairs is None:
+        ap.error("config has no data section to calibrate from")
+    itr = create_iterator(global_cfg + data_pairs)
+    try:
+        batches = (b.data for b in itertools.islice(
+            iter(itr), qc.calib_batches))
+        qblob, qm = quantize_blob(tr.net, blob, batches, qc)
+    finally:
+        close_chain(itr)
+
+    write_quantized_round(args.out, tr.graph.structure_signature(),
+                          qblob, qm)
+    out_digest = ckpt.blob_digest(ckpt.verify_model(args.out))
+    dv = drift_verdict(qm, qc.max_rel_err, qc.max_sat_frac)
+    rc = 0 if dv["ok"] else 2
+    if args.json:
+        print(json.dumps({
+            "src": args.src, "out": args.out,
+            "source_round": qm["source_round"],
+            "source_digest": qm["source_digest"],
+            "out_digest": out_digest,
+            "quantized_layers": qm["quantized_layers"],
+            "calib": qm["calib"],
+            "drift": dv, "exit_code": rc,
+        }, indent=1, sort_keys=True))
+        return rc
+    print("quantized %s (round %s, digest %s)"
+          % (args.src, qm["source_round"], qm["source_digest"]))
+    print("  -> %s (digest %s, %d int8 layers, calib %d batches @ p%g)"
+          % (args.out, out_digest, len(qm["quantized_layers"]),
+             qm["calib"]["batches"], qm["calib"]["percentile"]))
+    for r in dv["layers"]:
+        print("  %-32s rel_err %8.5f  sat_frac %8.5f  %s"
+              % (r["layer"], r["rel_err"], r["sat_frac"],
+                 "ok" if r["ok"] else "DRIFT"))
+    print(dv["line"])
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -416,6 +416,39 @@ def section_deployments(events: List[Dict], out: List[str]) -> None:
     out.append("")
 
 
+_QUANT_EVENTS = ("quant_calibrate", "cascade_escalate")
+
+
+def section_quantization(events: List[Dict], out: List[str]) -> None:
+    """Quantization line: PTQ calibration runs (which source round was
+    derived, how many layers) plus a cascade-escalation rollup — the
+    escalation rate IS the cost-per-request lever, so the report
+    states it rather than making readers count events."""
+    quant = [e for e in events if e.get("event") in _QUANT_EVENTS]
+    if not quant:
+        return
+    out.append("## Quantization")
+    out.append("")
+    calibs = [e for e in quant if e.get("event") == "quant_calibrate"]
+    for e in calibs[:20]:
+        out.append("- %s `h%s` **quant_calibrate**: source round %s "
+                   "(digest `%s`), %s layer(s) quantized, percentile "
+                   "%s" % (_ts(e.get("ts")), e.get("host", 0),
+                           e.get("source_round", "?"),
+                           e.get("source_digest", "?"),
+                           e.get("layers", "?"),
+                           e.get("percentile", "?")))
+    escs = [e for e in quant if e.get("event") == "cascade_escalate"]
+    if escs:
+        rows = sum(int(e.get("rows", 0)) for e in escs)
+        total = sum(int(e.get("total", 0)) for e in escs)
+        out.append("- cascade: %d escalation event(s), %d of %d rows "
+                   "escalated to the flagship tier (%.1f%%)"
+                   % (len(escs), rows, total,
+                      100.0 * rows / max(1, total)))
+    out.append("")
+
+
 _ELASTIC_EVENTS = ("elastic_join", "elastic_leave", "topology_change",
                    "elastic_resume", "elastic_advice")
 
@@ -690,6 +723,7 @@ def generate(ledger_path: str, telemetry_log: Optional[str],
     section_modelhealth(events, out)
     section_serving(events, out)
     section_deployments(events, out)
+    section_quantization(events, out)
     section_topology(events, out)
     section_checkpoints(events, out)
     section_critical_path(cp, out)

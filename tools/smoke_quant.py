@@ -1,0 +1,235 @@
+#!/usr/bin/env python
+"""Quantized-serving + cascade smoke check (CPU-safe).
+
+End-to-end proof of the int8 serving story, on the host CPU:
+
+  1. train a tiny fullc net for one round, checkpoint it;
+  2. quantize that round through the tools/quantize.py CLI (config-file
+     calibration stream, drift verdict, ``__quant_meta__`` provenance);
+  3. pick a cascade threshold at the median fast-tier confidence of the
+     bench payload (offline int8 forward), so the escalation rate lands
+     strictly inside (0, 1) by construction;
+  4. serve a two-tier cascade — int8 fast tier + fp32 flagship — behind
+     the HTTP server and drive the loadgen cascade bench (per-tier
+     pinned phases, escalation window, cost-per-request line);
+  5. assert ZERO failed requests, escalation rate in (0, 1), and that
+     cascade answers MATCH flagship-only answers on every escalated
+     row (the router must hand exactly the low-confidence rows to the
+     flagship and merge its answers back untouched);
+  6. assert the run ledger carries the quantized-serving timeline:
+     ``quant_calibrate`` (with source digest) and ``cascade_escalate``
+     alongside ``serve_start``.
+
+With ``-o PATH`` the cascade bench document is written as a
+``SERVE_r*.json`` artifact — on CPU the cost-per-request numbers are a
+session estimate per the README evidence policy.
+
+Exits nonzero on any failure.
+Run:  JAX_PLATFORMS=cpu python tools/smoke_quant.py [-o SERVE_r03.json]
+"""
+
+import argparse
+import http.client
+import json
+import os
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+
+NET_CFG = """
+netconfig=start
+layer[+1:h1] = fullc:fc1
+  nhidden = 32
+  random_type = xavier
+layer[+1:a1] = relu
+layer[a1->out] = fullc:fc2
+  nhidden = 5
+  random_type = xavier
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,16
+batch_size = 64
+eta = 0.3
+dev = cpu
+eval_train = 0
+"""
+
+SYN_ITER = """
+iter = synthetic
+num_inst = 512
+batch_size = 64
+num_class = 5
+input_shape = 1,1,16
+seed_data = 3
+"""
+
+ROWS = 16          # rows per bench request: per-row confidence variety
+WIDTH = 16
+
+
+def post_json(url: str, path: str, req: dict) -> dict:
+    from urllib.parse import urlparse
+    u = urlparse(url)
+    conn = http.client.HTTPConnection(u.hostname, u.port, timeout=60)
+    try:
+        conn.request("POST", path, body=json.dumps(req).encode("utf-8"),
+                     headers={"Content-Type": "application/json"})
+        r = conn.getresponse()
+        payload = r.read()
+        assert r.status == 200, f"{path} HTTP {r.status}: {payload[:200]!r}"
+        return json.loads(payload.decode("utf-8"))
+    finally:
+        conn.close()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("-o", "--out", default="",
+                    help="write the SERVE_r*.json artifact here")
+    ap.add_argument("--duration", type=float, default=4.0,
+                    help="open-loop seconds for the cascade phase")
+    ap.add_argument("--qps", type=float, default=20.0,
+                    help="open-loop target QPS (default 20)")
+    args = ap.parse_args()
+
+    import numpy as np
+    from cxxnet_tpu.config import parse_config_string, parse_quant_config
+    from cxxnet_tpu.io.data import create_iterator
+    from cxxnet_tpu.trainer import Trainer
+    from cxxnet_tpu import checkpoint as ckpt
+    from cxxnet_tpu.serve.cascade import CascadeRouter, row_confidence
+    from cxxnet_tpu.serve.server import ServeServer
+    from cxxnet_tpu.telemetry.ledger import LEDGER, new_run_id
+    from tools import loadgen, quantize
+
+    with tempfile.TemporaryDirectory() as td:
+        ledger_path = os.path.join(td, "quant.ledger.jsonl")
+        LEDGER.enable(ledger_path, new_run_id())
+
+        # 1 training round -> 0000.model
+        tr = Trainer(parse_config_string(NET_CFG))
+        tr.init_model()
+        for batch in create_iterator(parse_config_string(SYN_ITER)):
+            tr.update(batch)
+        tr.round_counter = 0
+        src_path = ckpt.model_path(td, 0)
+        tr.save_model(src_path)
+
+        # quantize through the CLI (config-file calibration stream)
+        cfg_path = os.path.join(td, "quant.conf")
+        with open(cfg_path, "w", encoding="utf-8") as f:
+            f.write(NET_CFG + "\ndata = train\n" + SYN_ITER + "iter = end\n")
+        q_path = os.path.join(td, "0000.int8.model")
+        # fan-in 16 puts >= 1/16 of each channel's weights at code 127
+        # by construction (the abs-max element itself), so the tiny net
+        # needs a saturation ceiling above that floor
+        rc = quantize.main([cfg_path, src_path, q_path,
+                            "quant_calib_batches=2",
+                            "quant_max_sat_frac=0.2"])
+        assert rc == 0, f"tools/quantize.py exited {rc} (drift UNSAFE?)"
+
+        qblob = ckpt.load_for_inference(q_path)
+        qm = ckpt.quant_meta(qblob["meta"])
+        assert qm is not None, "quantized round missing __quant_meta__"
+        assert qm["source_digest"] == ckpt.blob_digest(
+            ckpt.verify_model(src_path)), \
+            "quant provenance does not name the source round"
+
+        # threshold at the median fast-tier confidence of the EXACT
+        # bench payload -> escalation rate ~0.5, strictly inside (0,1)
+        rows = np.round(np.random.RandomState(0).randn(ROWS, WIDTH),
+                        4).astype(np.float32)
+        res = tr.net.apply(qblob["params"], qblob["state"],
+                           rows.reshape(ROWS, 1, 1, WIDTH), train=False)
+        conf = row_confidence(np.asarray(res.out), "margin")
+        thr = float(np.clip(np.median(conf), 0.02, 0.98))
+        esc_expect = conf < thr
+        assert 0 < int(esc_expect.sum()) < ROWS, \
+            f"degenerate offline escalation mask: {conf}"
+
+        qc = parse_quant_config(parse_config_string(
+            "cascade_enable = 1\ncascade_threshold = %.6f\n"
+            "cascade_metric = margin\n" % thr))
+        blob = ckpt.load_for_inference(src_path)
+        pool = CascadeRouter.build_two_tier(
+            NET_CFG, flagship_blob=blob, fast_blob=qblob, qc=qc,
+            n_flagship=1, n_fast=1,
+            flagship_digest=ckpt.blob_digest(blob["meta"]),
+            fast_digest=ckpt.blob_digest(qblob["meta"]),
+            buckets="2,4,8,16", max_batch=16, max_latency_ms=10,
+            slo_ms=0, silent=True)
+        srv = ServeServer(pool=pool, port=0, log_interval_s=0,
+                          silent=True, handle_signals=False).start()
+        url = f"http://127.0.0.1:{srv.port}"
+        try:
+            hz = loadgen._Endpoint(url).get_json("/healthz")
+            assert hz["status"] == "ok", f"/healthz not ok: {hz}"
+            vers = set(hz["versions"])
+            assert vers == {"r0000", "r0000-int8"}, \
+                f"expected two tier versions: {vers}"
+
+            bench = loadgen.run_cascade_bench(
+                url, qps=args.qps, duration_s=args.duration,
+                rows=ROWS, width=WIDTH, warmup_s=1.0,
+                note="CPU smoke (tools/smoke_quant.py): session "
+                     "estimate, no accelerator attached")
+
+            assert bench["failures"] == 0, \
+                f"loadgen saw failures: {bench['phases']}"
+            win = bench["open_window"]
+            assert win["failed"] == 0 and win["rejected"] == 0, \
+                f"server counted failures/rejections: {win}"
+            er = bench["escalation_rate"]
+            assert 0.0 < er < 1.0, f"escalation rate not in (0,1): {er}"
+            cost = bench["cost_per_request"]
+            assert cost["cascade_ms"] > 0, bench  # graftlint: disable=config-namespace (bench artifact field)
+
+            # escalated-row parity: cascade answers == flagship-only
+            # answers on every escalated row of the bench payload
+            payload = [[float(v) for v in r] for r in rows]
+            casc = np.asarray(post_json(url, "/predict",
+                                        {"data": payload})["pred"])
+            flag = np.asarray(post_json(
+                url, "/predict",
+                {"data": payload, "version": "r0000"})["pred"])
+            assert (casc[esc_expect] == flag[esc_expect]).all(), \
+                "cascade disagrees with flagship on escalated rows:\n" \
+                f"cascade={casc}\nflagship={flag}\nesc={esc_expect}"
+
+            # ledger: the quantized-serving timeline
+            events = [json.loads(l) for l in open(ledger_path)
+                      if l.strip()]
+            kinds = {e["event"] for e in events}
+            for want in ("quant_calibrate", "cascade_escalate",
+                         "serve_start"):
+                assert want in kinds, f"ledger missing {want}: {kinds}"
+            qcal = next(e for e in events
+                        if e["event"] == "quant_calibrate")
+            assert qcal["source_round"] == 0 and qcal["layers"] == 2, \
+                qcal
+
+            print("smoke_quant OK:", json.dumps({
+                "escalation_rate": er,
+                "fast_p50_ms": cost["fast_p50_ms"],
+                "flagship_p50_ms": cost["flagship_p50_ms"],
+                "cascade_cost_ms": cost["cascade_ms"],  # graftlint: disable=config-namespace (bench artifact field)
+                "threshold": thr,
+                "qps_sustained": bench["qps_sustained"]}))
+            if args.out:
+                with open(args.out, "w", encoding="utf-8") as f:
+                    f.write(json.dumps(bench, indent=2, sort_keys=True)
+                            + "\n")
+                print(f"artifact -> {args.out}")
+        finally:
+            srv.stop()
+            LEDGER.disable()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
